@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so the multi-device sharding
+layer (mesh + shard_map + halo collectives) is exercised without TPU
+hardware — the environment must be set before the first jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xC0FFEE)

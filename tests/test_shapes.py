@@ -1,0 +1,61 @@
+"""Shape-policy parity tests (memory.c:121-134, convolve.c:115-128, 240-248)."""
+
+import pytest
+
+from veles.simd_tpu import shapes
+
+
+def _c_zeropadding_length(length):
+    # Literal transcription of the reference's loop for differential checking.
+    nl = length
+    log = 2
+    while True:  # C: while (nl >>= 1) log++ — shift happens before the test
+        nl >>= 1
+        if nl == 0:
+            break
+        log += 1
+    return 1 << log
+
+
+def _c_fft_length(x_length, h_length):
+    m = x_length + h_length - 1
+    if m & (m - 1) != 0:
+        log = 1
+        while True:  # C: while (M >>= 1) log++
+            m >>= 1
+            if m == 0:
+                break
+            log += 1
+        m = 1 << log
+    return m
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 9, 127, 128, 129, 1000, 65536])
+def test_next_highest_power_of_2(n):
+    p = shapes.next_highest_power_of_2(n)
+    assert p >= n and p & (p - 1) == 0
+    assert p // 2 < n or n == 1
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 9, 50, 127, 128, 129, 1000])
+def test_zeropadding_length_matches_reference(n):
+    assert shapes.zeropadding_length(n) == _c_zeropadding_length(n)
+
+
+@pytest.mark.parametrize("h", [1, 2, 3, 4, 50, 127, 512, 950])
+def test_overlap_save_fft_length(h):
+    L = shapes.overlap_save_fft_length(h)
+    assert L == _c_zeropadding_length(h)
+    assert L - (h - 1) > 0  # positive block step
+    assert shapes.overlap_save_step(h) == L - (h - 1)
+
+
+@pytest.mark.parametrize("x,h", [(8, 4), (1020, 50), (350, 350), (65536, 127)])
+def test_fft_convolution_length(x, h):
+    assert shapes.fft_convolution_length(x, h) == _c_fft_length(x, h)
+
+
+def test_dwt_output_length():
+    assert shapes.dwt_output_length(32) == 16
+    with pytest.raises(ValueError):
+        shapes.dwt_output_length(33)

@@ -1,0 +1,89 @@
+"""Wavelet coefficient table properties.
+
+The tables are regenerated from the defining equations (see
+tools/gen_wavelet_tables.py); these tests pin the mathematical invariants
+and the reference's per-family normalization conventions
+(src/daubechies.c:34 orthonormal; src/symlets.c:34 and src/coiflets.c:34
+normalized to sum = 1).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import wavelet_data as wd
+
+
+ALL_FAMILIES = [("daubechies", o) for o in range(2, 77, 2)] + \
+               [("symlet", o) for o in range(2, 77, 2)] + \
+               [("coiflet", o) for o in range(6, 31, 6)]
+
+
+@pytest.mark.parametrize("family,order", ALL_FAMILIES)
+def test_orthonormality(family, order):
+    lo = wd.lowpass(family, order, np.float64)
+    # Daubechies rows are stored orthonormal; symlets/coiflets sum to 1.
+    h = lo if family == "daubechies" else lo * np.sqrt(2.0)
+    # h is now orthonormal: sum h = sqrt(2), sum h[n] h[n+2k] = delta_k
+    assert abs(np.sum(h) - np.sqrt(2.0)) < 1e-12
+    for k in range(1, order // 2):
+        dot = np.dot(h[: order - 2 * k], h[2 * k:])
+        assert abs(dot) < 1e-10, (family, order, k)
+    assert abs(np.dot(h, h) - 1.0) < 1e-10
+
+
+def test_known_db8_values():
+    # Standard order-8 (db4) scaling coefficients, as published everywhere.
+    lo = wd.lowpass("daubechies", 8, np.float64)
+    expected = [0.23037781330886, 0.71484657055292, 0.63088076792986,
+                -0.02798376941686, -0.18703481171909, 0.03084138183556,
+                0.03288301166689, -0.01059740178507]
+    np.testing.assert_allclose(lo, expected, atol=1e-12)
+
+
+def test_normalization_conventions():
+    assert abs(np.sum(wd.lowpass("daubechies", 2, np.float64)) - np.sqrt(2)) < 1e-12
+    assert abs(np.sum(wd.lowpass("symlet", 2, np.float64)) - 1.0) < 1e-12
+    assert abs(np.sum(wd.lowpass("coiflet", 6, np.float64)) - 1.0) < 1e-10
+
+
+def test_highpass_derivation():
+    # highpass[order-1-i] = +lowpass[i] (i odd) / -lowpass[i] (i even),
+    # per initialize_highpass_lowpass (src/wavelet.c:187-209).
+    hi, lo = wd.highpass_lowpass("daubechies", 8, np.float64)
+    for i in range(8):
+        expect = lo[i] if i % 2 == 1 else -lo[i]
+        assert hi[8 - 1 - i] == expect
+
+
+def test_stationary_dilation():
+    hi1, lo1 = wd.highpass_lowpass("daubechies", 4, np.float64)
+    hi2, lo2 = wd.stationary_highpass_lowpass("daubechies", 4, 2, np.float64)
+    assert lo2.shape == (8,)
+    np.testing.assert_array_equal(lo2[::2], lo1)
+    np.testing.assert_array_equal(lo2[1::2], 0)
+    # level 1 falls back to the plain pair
+    hi0, lo0 = wd.stationary_highpass_lowpass("daubechies", 4, 1, np.float64)
+    np.testing.assert_array_equal(lo0, lo1)
+    np.testing.assert_array_equal(hi0, hi1)
+
+
+def test_validate_order_parity():
+    # Mirrors wavelet_validate_order semantics (src/wavelet.c:83-98).
+    assert wd.validate_order("daubechies", 8)
+    assert wd.validate_order("daubechies", 76)
+    assert not wd.validate_order("daubechies", 78)
+    assert not wd.validate_order("daubechies", 7)
+    assert wd.validate_order("coiflet", 6)
+    assert wd.validate_order("coiflet", 30)
+    assert not wd.validate_order("coiflet", 8)
+    assert not wd.validate_order("coiflet", 36)
+    assert wd.validate_order("symlet", 2)
+    assert not wd.validate_order("symlet", 3)
+    assert not wd.validate_order("bogus", 8)
+
+
+def test_aliases():
+    np.testing.assert_array_equal(wd.lowpass("db", 8), wd.lowpass("daubechies", 8))
+    np.testing.assert_array_equal(wd.lowpass("sym", 8), wd.lowpass("symlet", 8))
+    with pytest.raises(ValueError):
+        wd.lowpass("haar", 2)

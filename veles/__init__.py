@@ -1,0 +1,1 @@
+"""veles namespace package — home of the TPU-native signal framework."""

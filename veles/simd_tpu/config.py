@@ -1,0 +1,63 @@
+"""Implementation-switch configuration.
+
+The reference library threads a runtime ``int simd`` flag through every
+dispatchable op (e.g. matrix.h:47, normalize.h:48, detect_peaks.h:61,
+mathfun.h:142) to choose between the SIMD backend and the scalar ``_na``
+twin. The TPU-native equivalent is an ``impl`` switch:
+
+  * ``"reference"`` — NumPy float64 oracle (the ``_na`` layer reborn);
+    not jittable, used as the differential-test ground truth.
+  * ``"xla"``       — jax.numpy / lax under ``jax.jit`` (XLA fusion owns the
+    schedule; the default).
+  * ``"pallas"``    — hand-written Pallas TPU kernels for the hot ops
+    (runs in interpret mode off-TPU, standing in for the reference's
+    AVX-emulation-on-SSE backend).
+
+The switch is honored per-call (``impl=`` keyword) or ambiently via
+``use_impl`` / the ``VELES_IMPL`` environment variable, so the reference's
+differential SIMD-vs-scalar test strategy (tests/matrix.cc:94-98) carries
+over unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+IMPLS = ("reference", "xla", "pallas")
+
+_state = threading.local()
+
+
+def _default_impl() -> str:
+    impl = os.environ.get("VELES_IMPL", "xla")
+    if impl not in IMPLS:
+        raise ValueError(f"VELES_IMPL must be one of {IMPLS}, got {impl!r}")
+    return impl
+
+
+def current_impl() -> str:
+    return getattr(_state, "impl", None) or _default_impl()
+
+
+def resolve_impl(impl: str | None) -> str:
+    """Resolve a per-call ``impl=`` argument against the ambient default."""
+    if impl is None:
+        return current_impl()
+    if impl not in IMPLS:
+        raise ValueError(f"impl must be one of {IMPLS} or None, got {impl!r}")
+    return impl
+
+
+@contextlib.contextmanager
+def use_impl(impl: str):
+    """Ambiently select an implementation backend within a scope."""
+    if impl not in IMPLS:
+        raise ValueError(f"impl must be one of {IMPLS}, got {impl!r}")
+    prev = getattr(_state, "impl", None)
+    _state.impl = impl
+    try:
+        yield
+    finally:
+        _state.impl = prev

@@ -1,0 +1,17 @@
+"""NumPy float64 reference oracle — the reference library's ``_na`` layer reborn.
+
+Every public op of the framework has a plain-NumPy, float64 implementation
+here. These are the ground truth for the differential test strategy
+(SIMD-vs-scalar in the reference, tests/matrix.cc:94-98; XLA/Pallas-vs-oracle
+here). They are deliberately simple, loop-free NumPy — never jitted, never
+run on TPU.
+"""
+
+from veles.simd_tpu.reference import arithmetic  # noqa: F401
+from veles.simd_tpu.reference import convolve  # noqa: F401
+from veles.simd_tpu.reference import correlate  # noqa: F401
+from veles.simd_tpu.reference import detect_peaks  # noqa: F401
+from veles.simd_tpu.reference import mathfun  # noqa: F401
+from veles.simd_tpu.reference import matrix  # noqa: F401
+from veles.simd_tpu.reference import normalize  # noqa: F401
+from veles.simd_tpu.reference import wavelet  # noqa: F401

@@ -1,0 +1,84 @@
+"""Oracle for the elementwise/conversion kernel layer.
+
+Semantics mirror the scalar ``_na`` kernels of inc/simd/arithmetic-inl.h:
+43-149. Conversions use C truncation-toward-zero; ``int16_multiply`` is the
+widening int16 x int16 -> int32 product (arithmetic-inl.h:169/:337/:730).
+Complex arrays follow the reference's interleaved-float layout
+[re0, im0, re1, im1, ...].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def int16_to_float(data):
+    return np.asarray(data, dtype=np.int16).astype(np.float32)
+
+
+def float_to_int16(data):
+    # C cast semantics: truncation toward zero (arithmetic-inl.h:50-57).
+    return np.trunc(np.asarray(data, dtype=np.float32)).astype(np.int16)
+
+
+def int32_to_float(data):
+    return np.asarray(data, dtype=np.int32).astype(np.float32)
+
+
+def float_to_int32(data):
+    return np.trunc(np.asarray(data, dtype=np.float32)).astype(np.int32)
+
+
+def int32_to_int16(data):
+    return np.asarray(data, dtype=np.int32).astype(np.int16)
+
+
+def int16_to_int32(data):
+    return np.asarray(data, dtype=np.int16).astype(np.int32)
+
+
+def real_multiply(a, b):
+    """Elementwise product (real_multiply_array_na, arithmetic-inl.h:92-98)."""
+    return (np.asarray(a, dtype=np.float64) * np.asarray(b, dtype=np.float64))
+
+
+real_multiply_array = real_multiply
+
+
+def real_multiply_scalar(array, value):
+    return np.asarray(array, dtype=np.float64) * np.float64(value)
+
+
+def complex_multiply(a, b):
+    """Interleaved complex product (complex_multiply_na, arithmetic-inl.h:100-109)."""
+    ca = np.asarray(a, dtype=np.float64).view(np.complex128)
+    cb = np.asarray(b, dtype=np.float64).view(np.complex128)
+    return (ca * cb).view(np.float64)
+
+
+def complex_multiply_conjugate(a, b):
+    """a * conj(b), interleaved (arithmetic-inl.h:111-120)."""
+    ca = np.asarray(a, dtype=np.float64).view(np.complex128)
+    cb = np.asarray(b, dtype=np.float64).view(np.complex128)
+    return (ca * np.conj(cb)).view(np.float64)
+
+
+def complex_conjugate(array):
+    """Negate imaginary lanes, interleaved (arithmetic-inl.h:122-129)."""
+    ca = np.asarray(array, dtype=np.float64).view(np.complex128)
+    return np.conj(ca).view(np.float64)
+
+
+def sum_elements(input):
+    return np.float64(np.sum(np.asarray(input, dtype=np.float64)))
+
+
+def add_to_all(input, value):
+    return np.asarray(input, dtype=np.float64) + np.float64(value)
+
+
+def int16_multiply(a, b):
+    """Widening elementwise product int16 x int16 -> int32."""
+    a = np.asarray(a, dtype=np.int16).astype(np.int32)
+    b = np.asarray(b, dtype=np.int16).astype(np.int32)
+    return a * b

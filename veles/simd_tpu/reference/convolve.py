@@ -1,0 +1,16 @@
+"""Oracle for 1-D convolution (full linear convolution, length x+h-1).
+
+All three reference algorithms (brute force convolve.c:40-101, full-FFT
+convolve.c:231-326, overlap-save convolve.c:156-229) compute the same
+mathematical full convolution; the oracle is the definition itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def convolve(x, h):
+    x = np.asarray(x, dtype=np.float64)
+    h = np.asarray(h, dtype=np.float64)
+    return np.convolve(x, h, mode="full")

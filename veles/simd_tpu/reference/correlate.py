@@ -1,0 +1,17 @@
+"""Oracle for 1-D cross-correlation.
+
+The reference computes correlation as convolution with a reversed kernel
+(correlate.c:74-126 brute force; rmemcpyf of h on the FFT paths,
+convolve.c:167-171, 302-306): result length x+h-1,
+result[j] = sum_m x[m] * h[m + (hLength-1) - j].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def cross_correlate(x, h):
+    x = np.asarray(x, dtype=np.float64)
+    h = np.asarray(h, dtype=np.float64)
+    return np.convolve(x, h[::-1], mode="full")

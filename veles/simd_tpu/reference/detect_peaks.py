@@ -1,0 +1,32 @@
+"""Oracle for peak detection (src/detect_peaks.c:41-127).
+
+A point at interior index i is an extremum when (x[i]-x[i-1]) * (x[i]-x[i+1])
+> 0 — a *strict* local max/min (plateaus are not peaks). Maxima require the
+maximum bit of the type mask, minima the minimum bit (detect_peaks.h:40-44:
+kExtremumTypeMaximum=1, kExtremumTypeMinimum=2, kExtremumTypeBoth=3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EXTREMUM_TYPE_MAXIMUM = 1
+EXTREMUM_TYPE_MINIMUM = 2
+EXTREMUM_TYPE_BOTH = 3
+
+
+def detect_peaks(data, extremum_type=EXTREMUM_TYPE_BOTH):
+    """Returns (positions int array, values array)."""
+    data = np.asarray(data, dtype=np.float64)
+    if data.size <= 2:
+        raise ValueError("size must be > 2 (detect_peaks.c:67)")
+    d1 = data[1:-1] - data[:-2]
+    d2 = data[1:-1] - data[2:]
+    strict = d1 * d2 > 0
+    sel = np.zeros_like(strict)
+    if extremum_type & EXTREMUM_TYPE_MAXIMUM:
+        sel |= strict & (d1 > 0)
+    if extremum_type & EXTREMUM_TYPE_MINIMUM:
+        sel |= strict & (d1 < 0)
+    positions = np.nonzero(sel)[0] + 1
+    return positions.astype(np.int32), data[positions]

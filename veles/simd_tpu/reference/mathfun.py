@@ -1,0 +1,22 @@
+"""Oracle for the vector transcendental layer (inc/simd/mathfun.h:142-204)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sin_psv(src):
+    return np.sin(np.asarray(src, dtype=np.float64))
+
+
+def cos_psv(src):
+    return np.cos(np.asarray(src, dtype=np.float64))
+
+
+def log_psv(src):
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.log(np.asarray(src, dtype=np.float64))
+
+
+def exp_psv(src):
+    return np.exp(np.asarray(src, dtype=np.float64))

@@ -1,0 +1,37 @@
+"""Oracle for the matrix layer (src/matrix.c:53-80 scalar kernels).
+
+Arrays are 2-D row-major, matching the reference's (pointer, w, h) layout.
+``matrix_multiply`` computes m1 @ m2 for m1 (h1, w1), m2 (w1, w2)
+(matrix.c:66-78, assert w1 == h2 at matrix.c:300); ``matrix_multiply_transposed``
+computes m1 @ m2.T for m2 stored row-contiguous (matrix.c:80-92).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _f64(a):
+    return np.asarray(a, dtype=np.float64)
+
+
+def matrix_add(m1, m2):
+    return _f64(m1) + _f64(m2)
+
+
+def matrix_sub(m1, m2):
+    return _f64(m1) - _f64(m2)
+
+
+def matrix_multiply(m1, m2):
+    m1, m2 = _f64(m1), _f64(m2)
+    if m1.shape[-1] != m2.shape[-2]:
+        raise ValueError(f"inner dims mismatch: {m1.shape} @ {m2.shape}")
+    return m1 @ m2
+
+
+def matrix_multiply_transposed(m1, m2):
+    m1, m2 = _f64(m1), _f64(m2)
+    if m1.shape[-1] != m2.shape[-1]:
+        raise ValueError(f"inner dims mismatch: {m1.shape} @ {m2.shape}.T")
+    return m1 @ m2.T

@@ -1,0 +1,37 @@
+"""Oracle for normalization & minmax (src/normalize.c).
+
+``normalize2D`` maps a uint8 plane into float32 [-1, 1]:
+dst = (src - min) / ((max - min)/2) - 1, with a zero fill when max == min
+(normalize.c:44-47, 211-262). Stride arguments of the C API are expressed
+here by passing array views. Note minmax semantics: the running min/max
+starts from src[0] (normalize.c:392-413).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def minmax2D(src):
+    src = np.asarray(src, dtype=np.uint8)
+    return np.uint8(src.min()), np.uint8(src.max())
+
+
+def minmax1D(src):
+    src = np.asarray(src, dtype=np.float64)
+    return np.float64(src.min()), np.float64(src.max())
+
+
+def normalize2D_minmax(vmin, vmax, src):
+    src = np.asarray(src, dtype=np.float64)
+    if vmin > vmax:
+        raise ValueError("min > max (normalize.c:483 assert)")
+    if vmin == vmax:
+        return np.zeros_like(src)
+    diff = (np.float64(vmax) - np.float64(vmin)) / 2.0
+    return (src - np.float64(vmin)) / diff - 1.0
+
+
+def normalize2D(src):
+    vmin, vmax = minmax2D(src)
+    return normalize2D_minmax(vmin, vmax, src)

@@ -1,0 +1,80 @@
+"""Oracle for the wavelet engine (src/wavelet.c:270-381 scalar kernels).
+
+The decimated transform slides the (highpass, lowpass) filter pair over the
+signal with stride 2, reading ``order`` extension samples past the end
+(correlation form — no filter reversal at application time; the reversal is
+baked into the highpass derivation). The stationary (à-trous) transform uses
+level-dilated filters, stride 1, full-length outputs.
+
+Extension modes follow initialize_extension (src/wavelet.c:247-268).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from veles.simd_tpu import wavelet_data
+
+EXTENSION_PERIODIC = "periodic"
+EXTENSION_MIRROR = "mirror"
+EXTENSION_CONSTANT = "constant"
+EXTENSION_ZERO = "zero"
+
+EXTENSION_TYPES = (EXTENSION_PERIODIC, EXTENSION_MIRROR, EXTENSION_CONSTANT,
+                   EXTENSION_ZERO)
+
+
+def extension(src, ext_length, ext):
+    """The ext_length samples appended past the end (wavelet.c:247-268)."""
+    src = np.asarray(src)
+    n = src.shape[-1]
+    i = np.arange(ext_length)
+    if ext == EXTENSION_PERIODIC:
+        return src[..., i % n]
+    if ext == EXTENSION_MIRROR:
+        return src[..., n - 1 - (i % n)]
+    if ext == EXTENSION_CONSTANT:
+        return np.broadcast_to(src[..., -1:], src.shape[:-1] + (ext_length,))
+    if ext == EXTENSION_ZERO:
+        return np.zeros(src.shape[:-1] + (ext_length,), dtype=src.dtype)
+    raise ValueError(f"unknown extension type {ext!r}; one of {EXTENSION_TYPES}")
+
+
+def wavelet_apply(src, wavelet_type="daubechies", order=8,
+                  ext=EXTENSION_PERIODIC):
+    """Single decimated DWT step -> (desthi, destlo), each length n/2.
+
+    Mirrors wavelet_apply_na (src/wavelet.c:270-322): out[d] =
+    sum_j f[j] * x_extended[2d + j].
+    """
+    src = np.asarray(src, dtype=np.float64)
+    n = src.shape[-1]
+    if n < 2 or n % 2 != 0:
+        # check_length (src/wavelet.c:49-52): positive and even. Signals
+        # shorter than the filter are valid — the order-length extension
+        # covers the overhang, exactly as in wavelet_apply_na.
+        raise ValueError(f"length {n} must be even and positive")
+    hi_f, lo_f = wavelet_data.highpass_lowpass(wavelet_type, order, np.float64)
+    x = np.concatenate([src, extension(src, order, ext)], axis=-1)
+    windows = np.lib.stride_tricks.sliding_window_view(x, order, axis=-1)
+    windows = windows[..., 0:n:2, :]
+    return windows @ hi_f, windows @ lo_f
+
+
+def stationary_wavelet_apply(src, wavelet_type="daubechies", order=8, level=1,
+                             ext=EXTENSION_PERIODIC):
+    """Single stationary (undecimated) WT step at ``level`` -> full-length pair.
+
+    Mirrors stationary_wavelet_apply_na (src/wavelet.c:324-381): the filters
+    are dilated by 2^(level-1) (zero-stuffed), stride is 1, outputs have the
+    input length.
+    """
+    src = np.asarray(src, dtype=np.float64)
+    n = src.shape[-1]
+    hi_f, lo_f = wavelet_data.stationary_highpass_lowpass(
+        wavelet_type, order, level, np.float64)
+    size = hi_f.shape[0]
+    x = np.concatenate([src, extension(src, size, ext)], axis=-1)
+    windows = np.lib.stride_tricks.sliding_window_view(x, size, axis=-1)
+    windows = windows[..., 0:n, :]
+    return windows @ hi_f, windows @ lo_f

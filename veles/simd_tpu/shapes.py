@@ -1,0 +1,68 @@
+"""Shape and padding policies.
+
+The reference's memory-layer pointer tricks (alignment complements,
+replicated wavelet lanes) are layout concerns XLA owns on TPU; what survives
+is their *observable* shape semantics, kept here as pure functions:
+
+  * ``next_highest_power_of_2`` — arithmetic-inl.h:1004-1012.
+  * ``zeropadding_length``      — the padding policy of ``zeropaddingex``
+    (memory.c:121-134): 2^(floor(log2 n) + 2), i.e. strictly more than 2n.
+  * ``overlap_save_fft_length`` — convolve_overlap_save_initialize's block
+    FFT size L derived from the kernel length (convolve.c:115-128).
+  * ``fft_convolution_length``  — convolve_fft_initialize's padded length M
+    (convolve.c:240-248): x+h-1 rounded up to a power of two.
+
+All are host-side Python ints usable as static jit arguments.
+"""
+
+from __future__ import annotations
+
+
+def next_highest_power_of_2(value: int) -> int:
+    """Smallest power of two >= value (arithmetic-inl.h:1004-1012)."""
+    if value <= 1:
+        return 1
+    return 1 << (value - 1).bit_length()
+
+
+def zeropadding_length(length: int) -> int:
+    """Padded length used by ``zeropadding``/``zeropaddingex``.
+
+    The reference computes 2^(floor(log2 n) + 2) (memory.c:117-134): for n a
+    power of two this is 4n, otherwise between 2n and 4n.
+    """
+    if length <= 0:
+        raise ValueError("length must be positive")
+    return 1 << (length.bit_length() + 1)
+
+
+def overlap_save_fft_length(h_length: int) -> int:
+    """Block FFT size L for overlap-save, from the kernel length.
+
+    Mirrors convolve_overlap_save_initialize (convolve.c:115-128), which
+    applies the zeropadding policy to the kernel length: L is ~4x the kernel
+    length, so the useful block step L - (M - 1) stays close to 3/4 of L.
+    """
+    return zeropadding_length(h_length)
+
+
+def fft_convolution_length(x_length: int, h_length: int) -> int:
+    """Padded FFT length for full-signal FFT convolution.
+
+    x+h-1 rounded up to the next power of two if not already one
+    (convolve.c:237-248).
+    """
+    m = x_length + h_length - 1
+    return next_highest_power_of_2(m)
+
+
+def overlap_save_step(h_length: int) -> int:
+    """Useful samples produced per overlap-save block: L - (M - 1)."""
+    return overlap_save_fft_length(h_length) - (h_length - 1)
+
+
+def dwt_output_length(length: int) -> int:
+    """Decimated DWT output length (wavelet.h:96: length/2, length even)."""
+    if length % 2 != 0:
+        raise ValueError("signal length must be even")
+    return length // 2
