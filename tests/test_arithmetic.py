@@ -1,0 +1,102 @@
+"""Differential tests: TPU arithmetic ops vs the float64 oracle.
+
+Mirrors the reference's SIMD-vs-scalar pattern (tests/arithmetic.cc:209-219:
+exact equality for conversions/integer ops, tolerance for float math).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.config import use_impl
+
+IMPLS = ["xla", "pallas"]
+LENGTHS = [1, 3, 64, 199, 1000]  # odd lengths exercise the padded-tail path
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("n", LENGTHS)
+def test_conversions_roundtrip(impl, n, rng):
+    i16 = rng.integers(-(2 ** 15), 2 ** 15 - 1, n, dtype=np.int16)
+    i32 = rng.integers(-(2 ** 20), 2 ** 20, n, dtype=np.int32)
+    f = (rng.normal(size=n) * 1000).astype(np.float32)
+
+    np.testing.assert_array_equal(ops.int16_to_float(i16, impl=impl),
+                                  ops.int16_to_float(i16, impl="reference"))
+    np.testing.assert_array_equal(ops.int32_to_float(i32, impl=impl),
+                                  ops.int32_to_float(i32, impl="reference"))
+    np.testing.assert_array_equal(ops.float_to_int16(f, impl=impl),
+                                  ops.float_to_int16(f, impl="reference"))
+    np.testing.assert_array_equal(ops.float_to_int32(f, impl=impl),
+                                  ops.float_to_int32(f, impl="reference"))
+    np.testing.assert_array_equal(ops.int16_to_int32(i16, impl=impl),
+                                  ops.int16_to_int32(i16, impl="reference"))
+    np.testing.assert_array_equal(ops.int32_to_int16(i32, impl=impl),
+                                  ops.int32_to_int16(i32, impl="reference"))
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("n", LENGTHS)
+def test_real_ops(impl, n, rng):
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(ops.real_multiply(a, b, impl=impl),
+                               ops.real_multiply(a, b, impl="reference"),
+                               rtol=1e-6)
+    np.testing.assert_allclose(ops.real_multiply_scalar(a, 2.5, impl=impl),
+                               ops.real_multiply_scalar(a, 2.5, impl="reference"),
+                               rtol=1e-6)
+    np.testing.assert_allclose(ops.add_to_all(a, 1.25, impl=impl),
+                               ops.add_to_all(a, 1.25, impl="reference"),
+                               rtol=1e-6)
+    np.testing.assert_allclose(ops.sum_elements(a, impl=impl),
+                               ops.sum_elements(a, impl="reference"),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("n", [2, 64, 198])
+def test_complex_ops(impl, n, rng):
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(ops.complex_multiply(a, b, impl=impl),
+                               ops.complex_multiply(a, b, impl="reference"),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        ops.complex_multiply_conjugate(a, b, impl=impl),
+        ops.complex_multiply_conjugate(a, b, impl="reference"),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ops.complex_conjugate(a, impl=impl),
+                               ops.complex_conjugate(a, impl="reference"),
+                               rtol=1e-6)
+
+
+def test_complex_native_passthrough(rng):
+    a = (rng.normal(size=8) + 1j * rng.normal(size=8)).astype(np.complex64)
+    b = (rng.normal(size=8) + 1j * rng.normal(size=8)).astype(np.complex64)
+    got = ops.complex_multiply(a, b)
+    np.testing.assert_allclose(np.asarray(got), a * b, rtol=1e-5)
+    assert np.iscomplexobj(np.asarray(got))
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_int16_multiply_widening(impl):
+    a = np.array([-30000, 30000, 123, 1], dtype=np.int16)
+    b = np.array([2, 2, -3, 0], dtype=np.int16)
+    got = ops.int16_multiply(a, b, impl=impl)
+    np.testing.assert_array_equal(got, [-60000, 60000, -369, 0])
+    assert np.asarray(got).dtype == np.int32
+
+
+def test_ambient_impl_switch(rng):
+    a = rng.normal(size=16).astype(np.float32)
+    with use_impl("reference"):
+        out = ops.real_multiply(a, a)
+    assert isinstance(out, np.ndarray) and out.dtype == np.float64
+    with use_impl("xla"):
+        out = ops.real_multiply(a, a)
+    assert not isinstance(out, np.ndarray)
+
+
+def test_next_highest_power_of_2_reexport():
+    assert ops.next_highest_power_of_2(100) == 128

@@ -1,0 +1,60 @@
+"""Differential tests for sin/cos/log/exp (tests/mathfun.cc:58-85 pattern).
+
+The pallas impl runs the Cephes polynomial bodies (the algorithms of
+avx_mathfun.h / neon_mathfun.h); accuracy expectations match the originals:
+~1e-7 relative on the primary range.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+LENGTHS = [1, 3, 64, 199, 1024]
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("n", LENGTHS)
+def test_sin_cos(impl, n, rng):
+    x = (rng.uniform(-50, 50, n)).astype(np.float32)
+    ref_sin = ops.sin_psv(x, impl="reference")
+    ref_cos = ops.cos_psv(x, impl="reference")
+    np.testing.assert_allclose(ops.sin_psv(x, impl=impl), ref_sin, atol=2e-6)
+    np.testing.assert_allclose(ops.cos_psv(x, impl=impl), ref_cos, atol=2e-6)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("n", LENGTHS)
+def test_exp(impl, n, rng):
+    x = (rng.uniform(-80, 80, n)).astype(np.float32)
+    ref = ops.exp_psv(x, impl="reference")
+    np.testing.assert_allclose(ops.exp_psv(x, impl=impl), ref, rtol=3e-6)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("n", LENGTHS)
+def test_log(impl, n, rng):
+    x = np.abs(rng.normal(size=n) * 100).astype(np.float32) + 1e-6
+    ref = ops.log_psv(x, impl="reference")
+    np.testing.assert_allclose(ops.log_psv(x, impl=impl), ref,
+                               rtol=1e-6, atol=2e-7)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_special_values(impl):
+    # sin/cos at exact octant boundaries; exp/log at edges.
+    x = np.array([0.0, np.pi / 4, np.pi / 2, np.pi, -np.pi / 2, 2 * np.pi],
+                 dtype=np.float32)
+    np.testing.assert_allclose(ops.sin_psv(x, impl=impl), np.sin(x), atol=2e-6)
+    np.testing.assert_allclose(ops.cos_psv(x, impl=impl), np.cos(x), atol=2e-6)
+    assert float(ops.exp_psv(np.float32([0.0]), impl=impl)[0]) == 1.0
+    assert float(ops.log_psv(np.float32([1.0]), impl=impl)[0]) == 0.0
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_log_nonpositive(impl):
+    x = np.array([0.0, -1.0, 1.0], dtype=np.float32)
+    out = np.asarray(ops.log_psv(x, impl=impl))
+    assert np.isneginf(out[0])
+    assert np.isnan(out[1])
+    assert out[2] == 0.0

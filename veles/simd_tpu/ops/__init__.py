@@ -1,0 +1,14 @@
+"""Public TPU-native operator layer.
+
+Each module mirrors one compiled operator family of the reference library
+(SURVEY §2) with an ``impl={"reference","xla","pallas"}`` switch standing in
+for the reference's runtime ``simd`` flag.
+"""
+
+from veles.simd_tpu.ops.arithmetic import (  # noqa: F401
+    add_to_all, complex_conjugate, complex_multiply,
+    complex_multiply_conjugate, float_to_int16, float_to_int32,
+    int16_multiply, int16_to_float, int16_to_int32, int32_to_float,
+    int32_to_int16, next_highest_power_of_2, real_multiply,
+    real_multiply_array, real_multiply_scalar, sum_elements)
+from veles.simd_tpu.ops.mathfun import cos_psv, exp_psv, log_psv, sin_psv  # noqa: F401

@@ -1,0 +1,20 @@
+"""Backend dispatch for the impl switch (see config.py)."""
+
+from __future__ import annotations
+
+from veles.simd_tpu.config import resolve_impl
+
+
+def dispatch(impl, reference_fn, xla_fn, pallas_fn=None):
+    """Select the implementation callable for a resolved impl name.
+
+    ``pallas_fn=None`` means the op has no hand kernel; the XLA lowering is
+    used (XLA's fusion is already optimal for most elementwise work — a
+    Pallas twin would only re-derive what the compiler does).
+    """
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return reference_fn
+    if impl == "pallas" and pallas_fn is not None:
+        return pallas_fn
+    return xla_fn

@@ -1,0 +1,66 @@
+"""Vector transcendentals (mathfun.h:142-204 reborn on TPU).
+
+``impl="xla"`` uses jnp's native sin/cos/log/exp (XLA's own lowering).
+``impl="pallas"`` runs the Cephes polynomial bodies — the exact algorithms
+of the reference's avx_mathfun.h/neon_mathfun.h — as a Pallas VPU kernel.
+``impl="reference"`` is the float64 NumPy oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.ops._dispatch import dispatch
+from veles.simd_tpu.pallas import cephes
+from veles.simd_tpu.reference import mathfun as _ref
+
+
+@jax.jit
+def _sin_xla(src):
+    return jnp.sin(jnp.asarray(src, jnp.float32))
+
+
+@jax.jit
+def _cos_xla(src):
+    return jnp.cos(jnp.asarray(src, jnp.float32))
+
+
+@jax.jit
+def _log_xla(src):
+    return jnp.log(jnp.asarray(src, jnp.float32))
+
+
+@jax.jit
+def _exp_xla(src):
+    return jnp.exp(jnp.asarray(src, jnp.float32))
+
+
+def _pallas(fn, pad_value):
+    def run(src):
+        from veles.simd_tpu.pallas.elementwise import elementwise
+        src = jnp.asarray(src, jnp.float32)
+        return elementwise(fn, src, pad_value=pad_value)
+    return run
+
+
+_sin_pallas = _pallas(cephes.sin_ps, 0.0)
+_cos_pallas = _pallas(cephes.cos_ps, 0.0)
+_log_pallas = _pallas(cephes.log_ps, 1.0)
+_exp_pallas = _pallas(cephes.exp_ps, 0.0)
+
+
+def sin_psv(src, *, impl=None):
+    return dispatch(impl, _ref.sin_psv, _sin_xla, _sin_pallas)(src)
+
+
+def cos_psv(src, *, impl=None):
+    return dispatch(impl, _ref.cos_psv, _cos_xla, _cos_pallas)(src)
+
+
+def log_psv(src, *, impl=None):
+    return dispatch(impl, _ref.log_psv, _log_xla, _log_pallas)(src)
+
+
+def exp_psv(src, *, impl=None):
+    return dispatch(impl, _ref.exp_psv, _exp_xla, _exp_pallas)(src)

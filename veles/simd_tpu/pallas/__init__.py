@@ -1,0 +1,20 @@
+"""Hand-written Pallas TPU kernels.
+
+These are the framework's counterpart of the reference's AVX/NEON intrinsic
+kernels: the hot inner loops, written against the TPU's VPU (8x128 vector
+unit) and MXU (128x128 systolic array). Off-TPU they run in Pallas interpret
+mode, playing the role the AVX-emulation-on-SSE header plays in the
+reference's test matrix (instruction_set.h:39-40).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.cache
+def use_interpret() -> bool:
+    """Interpret Pallas kernels when not running on a real TPU backend."""
+    return jax.default_backend() != "tpu"

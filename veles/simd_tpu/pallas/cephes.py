@@ -1,0 +1,128 @@
+"""Cephes-style float32 transcendental polynomials as elementwise jnp math.
+
+Algorithmic spec: the classic public-domain Cephes single-precision
+approximations, the same algorithms the reference vectorizes in its AVX and
+NEON mathfun headers (inc/simd/avx_mathfun.h:161-567, neon_mathfun.h:57-334).
+Written here once as pure elementwise jax.numpy/lax expressions so the same
+code body serves both as an XLA-fusible implementation and as the inner body
+of the Pallas VPU kernel (pallas/elementwise.py) — the TPU analogue of the
+reference's "header-only inline kernel" layer (arithmetic-inl.h).
+
+Accuracy matches the Cephes originals: ~1-2 ulp on the primary range, with
+sin/cos degrading for |x| >~ 8192 exactly as the AVX/NEON versions do (they
+share the 3-term extended-precision pi/4 reduction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_F32 = jnp.float32
+_I32 = jnp.int32
+
+# exp constants (Cephes expf)
+_LOG2EF = 1.44269504088896341
+_EXP_C1 = 0.693359375
+_EXP_C2 = -2.12194440e-4
+_EXP_HI = 88.3762626647950
+_EXP_LO = -88.3762626647949
+_EXP_P = (1.9875691500e-4, 1.3981999507e-3, 8.3334519073e-3,
+          4.1665795894e-2, 1.6666665459e-1, 5.0000001201e-1)
+
+# log constants (Cephes logf)
+_SQRTHF = 0.707106781186547524
+_LOG_P = (7.0376836292e-2, -1.1514610310e-1, 1.1676998740e-1,
+          -1.2420140846e-1, 1.4249322787e-1, -1.6668057665e-1,
+          2.0000714765e-1, -2.4999993993e-1, 3.3333331174e-1)
+_LOG_Q1 = -2.12194440e-4
+_LOG_Q2 = 0.693359375
+
+# sin/cos constants (Cephes sinf/cosf)
+_FOPI = 1.27323954473516  # 4/pi
+_DP1, _DP2, _DP3 = -0.78515625, -2.4187564849853515625e-4, -3.77489497744594108e-8
+_SINCOF = (-1.9515295891e-4, 8.3321608736e-3, -1.6666654611e-1)
+_COSCOF = (2.443315711809948e-5, -1.388731625493765e-3, 4.166664568298827e-2)
+
+
+def _poly(coeffs, x):
+    acc = jnp.full_like(x, coeffs[0])
+    for c in coeffs[1:]:
+        acc = acc * x + c
+    return acc
+
+
+def exp_ps(x):
+    """Cephes expf.
+
+    Behavioral parity note: for x in [88.3763, 88.7228] this returns +inf
+    (n rounds to 128, overflowing the exponent-field 2^n construction) even
+    though float32 could represent the value — exactly as the reference's
+    exp256_ps/NEON exp_ps do. The default impl="xla" path is exact there.
+    """
+    x = jnp.asarray(x, _F32)
+    xc = jnp.clip(x, _EXP_LO, _EXP_HI)
+    n = jnp.floor(xc * _LOG2EF + 0.5)
+    r = xc - n * _EXP_C1 - n * _EXP_C2
+    y = _poly(_EXP_P, r)
+    y = y * r * r + r + 1.0
+    # 2^n by exponent-field construction (the ldexp idiom of the SIMD originals)
+    pow2n = jax.lax.bitcast_convert_type(
+        (n.astype(_I32) + 127) << 23, _F32)
+    return (y * pow2n).astype(_F32)
+
+
+def log_ps(x):
+    x = jnp.asarray(x, _F32)
+    invalid = x < 0
+    zero = x == 0
+    xs = jnp.maximum(x, jnp.float32(1.17549435e-38))  # flush denormals/nonpos
+    xi = jax.lax.bitcast_convert_type(xs, _I32)
+    e = ((xi >> 23) & 0xFF) - 126
+    m = jax.lax.bitcast_convert_type(
+        (xi & 0x007FFFFF) | jnp.int32(0x3F000000), _F32)  # m in [0.5, 1)
+    below = m < _SQRTHF
+    e = e - below.astype(_I32)
+    m = jnp.where(below, m + m, m) - 1.0
+    z = m * m
+    y = _poly(_LOG_P, m) * m * z
+    ef = e.astype(_F32)
+    y = y + ef * _LOG_Q1
+    y = y - 0.5 * z
+    res = m + y + ef * _LOG_Q2
+    res = jnp.where(zero, -jnp.inf, res)
+    res = jnp.where(invalid, jnp.nan, res)
+    res = jnp.where(jnp.isinf(x) & (x > 0), jnp.inf, res)
+    return res.astype(_F32)
+
+
+def _sin_cos_core(x):
+    """Shared octant reduction; returns (sin(x), cos(x))."""
+    xa = jnp.abs(x)
+    j = (xa * _FOPI).astype(_I32)
+    j = j + (j & 1)  # round up odd octants (Cephes j = (j + 1) & ~1)
+    y = j.astype(_F32)
+    j = j & 7
+    fold = j > 3  # quadrant fold: sign flip for both polynomials
+    j = j - jnp.where(fold, 4, 0)
+    use_cos = (j == 1) | (j == 2)
+    xr = xa + y * _DP1 + y * _DP2 + y * _DP3
+    z = xr * xr
+    poly_cos = _poly(_COSCOF, z) * z * z - 0.5 * z + 1.0
+    poly_sin = _poly(_SINCOF, z) * z * xr + xr
+    fold_sign = jnp.where(fold, -1.0, 1.0).astype(_F32)
+    sin_val = jnp.where(use_cos, poly_cos, poly_sin) * fold_sign
+    sin_val = sin_val * jnp.sign(x).astype(_F32)
+    cos_sign = fold_sign * jnp.where(j > 1, -1.0, 1.0).astype(_F32)
+    cos_val = jnp.where(use_cos, poly_sin, poly_cos) * cos_sign
+    return sin_val, cos_val
+
+
+def sin_ps(x):
+    x = jnp.asarray(x, _F32)
+    return _sin_cos_core(x)[0]
+
+
+def cos_ps(x):
+    x = jnp.asarray(x, _F32)
+    return _sin_cos_core(x)[1]

@@ -1,0 +1,73 @@
+"""Generic elementwise Pallas VPU kernel wrapper.
+
+The TPU analogue of the reference's inline SIMD loop skeleton
+(mathfun.h:44-139: 8-wide vector body + scalar tail): arrays are laid out as
+(rows, 128) lane tiles, the grid walks row blocks, and the "scalar tail" is
+replaced by padding to the tile size and slicing the result — dynamic tails
+are hostile to the MXU/VPU tiling model, padding is free in comparison.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from veles.simd_tpu.pallas import use_interpret
+
+_LANE = 128
+_MAX_BLOCK_ROWS = 512  # 512 x 128 x 4B = 256 KiB per operand block in VMEM
+
+
+def _pad_to_tiles(flat, block_rows, pad_value):
+    n = flat.shape[0]
+    per_block = block_rows * _LANE
+    total = -(-n // per_block) * per_block
+    flat = jnp.pad(flat, (0, total - n), constant_values=pad_value)
+    return flat.reshape(total // per_block * block_rows, _LANE)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _run(fn, block_rows, out_dtype, pad_value, n, *arrays):
+    padded = [_pad_to_tiles(a.ravel(), block_rows, pad_value) for a in arrays]
+    rows = padded[0].shape[0]
+
+    def kernel(*refs):
+        out_ref = refs[-1]
+        out_ref[:] = fn(*(r[:] for r in refs[:-1]))
+
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[spec] * len(padded),
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), out_dtype),
+        interpret=use_interpret(),
+    )(*padded)
+    return out.ravel()[:n]
+
+
+def elementwise(fn, *arrays, out_dtype=None, pad_value=1.0):
+    """Apply an elementwise jnp function via a Pallas kernel.
+
+    ``fn`` must be shape-preserving and elementwise (the cephes.py bodies
+    qualify). ``pad_value`` fills the tile remainder — pick one in ``fn``'s
+    domain so the padding lanes don't trap (e.g. 1.0 for log).
+    """
+    arrays = jnp.broadcast_arrays(*(jnp.asarray(a) for a in arrays))
+    shape = arrays[0].shape
+    n = arrays[0].size
+    if out_dtype is None:
+        out_dtype = arrays[0].dtype
+    rows_needed = -(-n // _LANE)
+    if rows_needed <= 8:
+        block_rows = 8
+    elif rows_needed <= 64:
+        block_rows = 64
+    else:
+        block_rows = _MAX_BLOCK_ROWS
+    out = _run(fn, block_rows, jnp.dtype(out_dtype), float(pad_value), n, *arrays)
+    return out.reshape(shape)

@@ -17,8 +17,11 @@ def int16_to_float(data):
 
 
 def float_to_int16(data):
-    # C cast semantics: truncation toward zero (arithmetic-inl.h:50-57).
-    return np.trunc(np.asarray(data, dtype=np.float32)).astype(np.int16)
+    # Truncation toward zero (arithmetic-inl.h:50-57). Out-of-range values
+    # saturate: the C cast is UB there, and XLA converts saturate, so the
+    # framework defines saturation as the semantics.
+    t = np.trunc(np.asarray(data, dtype=np.float32))
+    return np.clip(t, -32768, 32767).astype(np.int16)
 
 
 def int32_to_float(data):
@@ -26,7 +29,8 @@ def int32_to_float(data):
 
 
 def float_to_int32(data):
-    return np.trunc(np.asarray(data, dtype=np.float32)).astype(np.int32)
+    t = np.trunc(np.asarray(data, dtype=np.float32))
+    return np.clip(t, -(2.0 ** 31), 2.0 ** 31 - 1).astype(np.int32)
 
 
 def int32_to_int16(data):
