@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Benchmark harness. Prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md): matrix_multiply float32 N=4096 on one chip,
+reported as achieved GFLOPS. ``vs_baseline`` is the ratio against the
+north-star target of 50% MXU utilization at the v5e bf16 peak
+(0.5 * 197 TFLOPS = 98.5 TFLOPS); >= 1.0 means the target is met.
+
+Measurement method: the op is iterated inside one jit'd lax.scan with a data
+dependency between steps (the axon tunnel defers execution past
+block_until_ready, so wall-clocking individual dispatches measures nothing —
+a chained scan with a scalar checksum fetch is the only honest clock here).
+
+``python bench.py --all`` additionally reports the secondary BASELINE
+configs on stderr as they come online.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+V5E_BF16_PEAK_GFLOPS = 197_000.0
+TARGET_GFLOPS = 0.5 * V5E_BF16_PEAK_GFLOPS
+
+
+def _bench_chain(step_fn, carry, iters):
+    """Time iters sequential applications of step_fn inside one jit."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(c):
+        def body(c, _):
+            return step_fn(c), None
+        c, _ = jax.lax.scan(body, c, None, length=iters)
+        return jnp.sum(c.astype(jnp.float32))
+
+    float(chain(carry))  # compile + warm
+    t0 = time.perf_counter()
+    checksum = float(chain(carry))
+    dt = (time.perf_counter() - t0) / iters
+    if not np.isfinite(checksum):
+        raise RuntimeError(f"non-finite checksum {checksum}")
+    return dt
+
+
+def bench_matmul_4096():
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = 4096 if on_tpu else 256  # CPU smoke fallback; driver runs on TPU
+    iters = 64 if on_tpu else 4
+    k1, k2 = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(k1, (n, n), jnp.float32)
+    b = jax.random.normal(k2, (n, n), jnp.float32) / jnp.float32(np.sqrt(n))
+
+    from veles.simd_tpu import ops
+
+    dt = _bench_chain(lambda c: ops.matrix_multiply(c, b), a, iters)
+    gflops = 2 * n ** 3 / dt / 1e9
+    return {
+        "metric": f"matrix_multiply_f32_n{n}",
+        "value": round(gflops, 1),
+        "unit": "GFLOPS",
+        "vs_baseline": round(gflops / TARGET_GFLOPS, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="also run secondary configs (reported on stderr)")
+    args = ap.parse_args()
+
+    result = bench_matmul_4096()
+
+    if args.all:
+        try:
+            from veles.simd_tpu.utils.bench_extra import run_secondary
+            run_secondary(sys.stderr)
+        except ImportError:
+            print("secondary configs not yet available", file=sys.stderr)
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Differential matrix tests (tests/matrix.cc:94-204 pattern).
+
+Dimension tuples include odd sizes to exercise the pad-and-slice path that
+replaces the reference's scalar tails (tests/matrix.cc:159-204 uses 99 and
+125x299x999 for the same reason).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+SHAPES = [(4, 4, 4), (8, 8, 8), (99, 35, 77), (1, 7, 1), (16, 128, 256),
+          (125, 64, 33)]
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("h1,w1,w2", SHAPES)
+def test_matrix_multiply(impl, h1, w1, w2, rng):
+    m1 = rng.normal(size=(h1, w1)).astype(np.float32)
+    m2 = rng.normal(size=(w1, w2)).astype(np.float32)
+    ref = ops.matrix_multiply(m1, m2, impl="reference")
+    kwargs = {"precision": "highest"} if impl == "xla" else {}
+    got = np.asarray(ops.matrix_multiply(m1, m2, impl=impl, **kwargs))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("h1,w1,h2", [(4, 4, 4), (99, 35, 77), (16, 128, 64)])
+def test_matrix_multiply_transposed(impl, h1, w1, h2, rng):
+    m1 = rng.normal(size=(h1, w1)).astype(np.float32)
+    m2 = rng.normal(size=(h2, w1)).astype(np.float32)
+    ref = ops.matrix_multiply_transposed(m1, m2, impl="reference")
+    kwargs = {"precision": "highest"} if impl == "xla" else {}
+    got = np.asarray(ops.matrix_multiply_transposed(m1, m2, impl=impl, **kwargs))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-4)
+    # identity: multiply_transposed(m1, m2) == multiply(m1, m2.T)
+    got2 = np.asarray(ops.matrix_multiply(m1, m2.T, impl=impl, **kwargs))
+    np.testing.assert_allclose(got, got2, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_add_sub(impl, rng):
+    a = rng.normal(size=(33, 65)).astype(np.float32)
+    b = rng.normal(size=(33, 65)).astype(np.float32)
+    np.testing.assert_allclose(ops.matrix_add(a, b, impl=impl),
+                               ops.matrix_add(a, b, impl="reference"),
+                               rtol=1e-6)
+    np.testing.assert_allclose(ops.matrix_sub(a, b, impl=impl),
+                               ops.matrix_sub(a, b, impl="reference"),
+                               rtol=1e-6)
+
+
+def test_multiply_golden():
+    m1 = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    m2 = np.array([[5.0, 6.0], [7.0, 8.0]], dtype=np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ops.matrix_multiply(m1, m2)), [[19, 22], [43, 50]])
+    np.testing.assert_array_equal(
+        np.asarray(ops.matrix_multiply_transposed(m1, m2)), [[17, 23], [39, 53]])
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_shape_contract(impl):
+    with pytest.raises(ValueError):
+        ops.matrix_multiply(np.zeros((2, 3), np.float32),
+                            np.zeros((2, 3), np.float32), impl=impl)
+    with pytest.raises(ValueError):
+        ops.matrix_multiply_transposed(np.zeros((2, 3), np.float32),
+                                       np.zeros((3, 2), np.float32), impl=impl)

@@ -12,3 +12,5 @@ from veles.simd_tpu.ops.arithmetic import (  # noqa: F401
     int32_to_int16, next_highest_power_of_2, real_multiply,
     real_multiply_array, real_multiply_scalar, sum_elements)
 from veles.simd_tpu.ops.mathfun import cos_psv, exp_psv, log_psv, sin_psv  # noqa: F401
+from veles.simd_tpu.ops.matrix import (  # noqa: F401
+    matrix_add, matrix_multiply, matrix_multiply_transposed, matrix_sub)

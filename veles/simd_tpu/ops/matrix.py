@@ -1,0 +1,87 @@
+"""Matrix operators (inc/simd/matrix.h reborn on the MXU).
+
+``matrix_multiply`` is (h1, w1) @ (w1, w2) with w1 == h2 asserted, exactly
+as src/matrix.c:297-319; ``matrix_multiply_transposed`` contracts both
+operands' last dims (m1 @ m2.T, matrix.c:228-252 — the reference documents
+it ~10% faster since both operands stream row-contiguously; on TPU both
+forms are a single dot_general and XLA picks the layout).
+
+``precision`` controls the MXU pass structure for float32 inputs on the xla
+impl: ``None``/DEFAULT uses fast single-pass bf16 products, ``"high"`` the
+bf16_3x scheme, ``"highest"`` the full float32 product. The pallas impl
+always runs the MXU's native bf16-product/f32-accumulation mode and rejects
+a precision argument. Differential tests run xla at HIGHEST against the
+float64 oracle; benchmarks report DEFAULT (the TPU-native operating point).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.ops._dispatch import dispatch
+from veles.simd_tpu.reference import matrix as _ref
+
+
+@jax.jit
+def _matrix_add_xla(m1, m2):
+    return jnp.asarray(m1) + jnp.asarray(m2)
+
+
+@jax.jit
+def _matrix_sub_xla(m1, m2):
+    return jnp.asarray(m1) - jnp.asarray(m2)
+
+
+def matrix_add(m1, m2, *, impl=None):
+    return dispatch(impl, _ref.matrix_add, _matrix_add_xla)(m1, m2)
+
+
+def matrix_sub(m1, m2, *, impl=None):
+    return dispatch(impl, _ref.matrix_sub, _matrix_sub_xla)(m1, m2)
+
+
+@functools.partial(jax.jit, static_argnames=("precision", "transpose_b"))
+def _matmul_xla(m1, m2, precision=None, transpose_b=False):
+    dims = (((1,), (1 if transpose_b else 0,)), ((), ()))
+    return jax.lax.dot_general(m1, m2, dims, precision=precision)
+
+
+def _check_mm(m1, m2, transpose_b):
+    m1 = jnp.asarray(m1)
+    m2 = jnp.asarray(m2)
+    op = "@T" if transpose_b else "@"
+    if m1.ndim != 2 or m2.ndim != 2:
+        raise ValueError(f"bad shapes: {m1.shape} {op} {m2.shape}")
+    inner = m2.shape[1] if transpose_b else m2.shape[0]
+    if m1.shape[1] != inner:
+        raise ValueError(f"bad shapes: {m1.shape} {op} {m2.shape}")
+    return m1, m2
+
+
+def _mm(m1, m2, impl, precision, transpose_b):
+    from veles.simd_tpu.config import resolve_impl
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        ref_fn = (_ref.matrix_multiply_transposed if transpose_b
+                  else _ref.matrix_multiply)
+        return ref_fn(m1, m2)
+    m1, m2 = _check_mm(m1, m2, transpose_b)
+    if impl == "pallas":
+        if precision is not None:
+            raise ValueError(
+                "impl='pallas' computes bf16-product/float32-accumulation "
+                "(the MXU's native mode); use impl='xla' for precision control")
+        from veles.simd_tpu.pallas.matmul import matmul
+        return matmul(m1, m2, transpose_b=transpose_b)
+    return _matmul_xla(m1, m2, precision=precision, transpose_b=transpose_b)
+
+
+def matrix_multiply(m1, m2, *, impl=None, precision=None):
+    return _mm(m1, m2, impl, precision, transpose_b=False)
+
+
+def matrix_multiply_transposed(m1, m2, *, impl=None, precision=None):
+    return _mm(m1, m2, impl, precision, transpose_b=True)

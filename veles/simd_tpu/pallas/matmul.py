@@ -1,0 +1,118 @@
+"""Tiled MXU matmul Pallas kernel.
+
+The reference's matrix_multiply walks per-output dot products with a copied
+column (src/matrix.c:200-226) — an O(n^3) non-blocked schedule. On TPU the
+same contraction belongs to the MXU systolic array; this kernel tiles the
+output into (bm, bn) blocks, walks K in bk steps as the innermost grid
+dimension, and accumulates in a float32 VMEM scratch so the MXU stays fed
+from on-chip memory (the Pallas guide's canonical matmul schedule).
+
+``transpose_b=True`` contracts both operands' last dimensions (m1 @ m2.T)
+by swapping the B-operand's block index map — no transpose copy is
+materialized, mirroring how the reference's matrix_multiply_transposed
+streams both operands row-contiguously (matrix.c:228-252).
+
+Precision: the MXU multiplies bf16 with float32 accumulation (its native
+mode) for float32 inputs — the same operating point as XLA's DEFAULT
+precision. For the full float32 multi-pass product use the xla impl with
+precision="highest".
+
+Used by ops.matrix with impl="pallas"; impl="xla" lowers the same op to one
+lax.dot_general call, which XLA tiles equivalently — the hand kernel exists
+to own the schedule for the MXU-utilization benchmark target
+(BASELINE.md: >= 50% at N=4096).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles.simd_tpu.pallas import use_interpret
+
+
+def _make_kernel(transpose_b):
+    contract = (((1,), (1 if transpose_b else 0,)), ((), ()))
+
+    def kernel(x_ref, y_ref, o_ref, acc_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jax.lax.dot_general(
+            x_ref[:], y_ref[:], contract,
+            preferred_element_type=jnp.float32)
+
+        @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+        def _flush():
+            o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+    return kernel
+
+
+_KERNEL_NT = _make_kernel(False)
+_KERNEL_T = _make_kernel(True)
+
+
+def _pad_dim(a, axis, mult):
+    size = a.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "transpose_b"))
+def _matmul_padded(x, y, bm, bn, bk, transpose_b=False):
+    m, k = x.shape
+    n = y.shape[0] if transpose_b else y.shape[1]
+    grid = (m // bm, n // bn, k // bk)
+    if transpose_b:
+        y_spec = pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk))
+    else:
+        y_spec = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
+    return pl.pallas_call(
+        _KERNEL_T if transpose_b else _KERNEL_NT,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)), y_spec],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=use_interpret(),
+    )(x, y)
+
+
+def matmul(x, y, *, transpose_b=False, bm=512, bn=512, bk=512):
+    """x @ y (or x @ y.T) via the tiled Pallas kernel; shapes zero-padded."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    inner = y.shape[-1] if transpose_b else y.shape[0]
+    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != inner:
+        op = "@T" if transpose_b else "@"
+        raise ValueError(f"bad matmul shapes: {x.shape} {op} {y.shape}")
+    m, k = x.shape
+    n = y.shape[0] if transpose_b else y.shape[1]
+    if m == 0 or n == 0 or k == 0:
+        return jnp.zeros((m, n), dtype=x.dtype)
+    bm_ = min(bm, _ceil_mult(m, 8))
+    bn_ = min(bn, _ceil_mult(n, 128))
+    bk_ = min(bk, _ceil_mult(k, 128))
+    xp = _pad_dim(_pad_dim(x, 0, bm_), 1, bk_)
+    if transpose_b:
+        yp = _pad_dim(_pad_dim(y, 0, bn_), 1, bk_)
+    else:
+        yp = _pad_dim(_pad_dim(y, 0, bk_), 1, bn_)
+    out = _matmul_padded(xp, yp, bm_, bn_, bk_, transpose_b)
+    return out[:m, :n]
+
+
+def _ceil_mult(size, mult):
+    return -(-size // mult) * mult
