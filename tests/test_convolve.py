@@ -1,0 +1,110 @@
+"""Convolution/correlation tests (tests/convolve.cc + correlate.cc patterns).
+
+Golden vectors from the reference tests; differential sweeps over the same
+size grid the reference benchmarks (x in {32..2000}, h in {50..950}) with
+every algorithm forced, plus the auto-selector contract.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+GOLDEN_X = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype=np.float32)
+GOLDEN_H = np.array([10, 9, 8, 7], dtype=np.float32)
+GOLDEN_CONV = [10, 29, 56, 90, 124, 158, 192, 226, 170, 113, 56]
+GOLDEN_CORR = [7, 22, 46, 80, 114, 148, 182, 216, 187, 142, 80]
+
+
+@pytest.mark.parametrize("algorithm", ["direct", "fft"])
+def test_convolve_golden(algorithm):
+    got = np.asarray(ops.convolve(GOLDEN_X, GOLDEN_H, algorithm=algorithm))
+    np.testing.assert_allclose(got, GOLDEN_CONV, atol=1e-3)
+
+
+@pytest.mark.parametrize("algorithm", ["direct", "fft"])
+def test_correlate_golden(algorithm):
+    got = np.asarray(ops.cross_correlate(GOLDEN_X, GOLDEN_H,
+                                         algorithm=algorithm))
+    np.testing.assert_allclose(got, GOLDEN_CORR, atol=1e-3)
+
+
+# The reference's benchmark grid (tests/convolve.cc:171-400), trimmed to the
+# shapes that satisfy each algorithm's preconditions.
+SIZES = [(32, 5), (50, 12), (200, 50), (350, 127), (1020, 50), (2000, 512),
+         (2000, 950), (333, 77)]
+
+
+@pytest.mark.parametrize("x_len,h_len", SIZES)
+@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
+def test_convolve_differential(x_len, h_len, algorithm, rng):
+    if algorithm == "overlap_save" and h_len >= x_len / 2:
+        pytest.skip("overlap_save precondition")
+    x = rng.normal(size=x_len).astype(np.float32)
+    h = rng.normal(size=h_len).astype(np.float32)
+    ref = ops.convolve(x, h, impl="reference")
+    got = np.asarray(ops.convolve(x, h, algorithm=algorithm))
+    assert got.shape == (x_len + h_len - 1,)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
+
+
+@pytest.mark.parametrize("x_len,h_len", SIZES)
+@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
+def test_correlate_differential(x_len, h_len, algorithm, rng):
+    if algorithm == "overlap_save" and h_len >= x_len / 2:
+        pytest.skip("overlap_save precondition")
+    x = rng.normal(size=x_len).astype(np.float32)
+    h = rng.normal(size=h_len).astype(np.float32)
+    ref = ops.cross_correlate(x, h, impl="reference")
+    got = np.asarray(ops.cross_correlate(x, h, algorithm=algorithm))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
+
+
+def test_convolve_commutative(rng):
+    # conv(x, h) == conv(h, x); the reference's FFT path is symmetric too.
+    x = rng.normal(size=100).astype(np.float32)
+    h = rng.normal(size=31).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(ops.convolve(x, h)),
+                               np.asarray(ops.convolve(h, x)), atol=1e-3)
+
+
+def test_selector_contract():
+    # Structure parity with convolve_initialize (convolve.c:328-366):
+    # long signal with small kernel -> overlap_save; balanced big -> fft;
+    # small -> direct.
+    assert ops.select_algorithm(65536, 127) == "overlap_save"
+    assert ops.select_algorithm(8192, 8192) == "fft"
+    assert ops.select_algorithm(64, 16) == "direct"
+    assert ops.convolve_initialize(65536, 127).algorithm == "overlap_save"
+    assert ops.convolve_initialize(64, 16).algorithm == "direct"
+
+
+def test_handle_api(rng):
+    x = rng.normal(size=1020).astype(np.float32)
+    h = rng.normal(size=50).astype(np.float32)
+    handle = ops.convolve_initialize(1020, 50, algorithm="fft")
+    out1 = np.asarray(handle(x, h))
+    np.testing.assert_allclose(out1, ops.convolve(x, h, impl="reference"),
+                               rtol=2e-4, atol=2e-3)
+    ops.convolve_finalize(handle)  # no-op, parity
+    with pytest.raises(ValueError):
+        handle(x[:100], h)
+    corr_handle = ops.cross_correlate_initialize(1020, 50, algorithm="fft")
+    assert corr_handle.reverse
+    np.testing.assert_allclose(np.asarray(corr_handle(x, h)),
+                               ops.cross_correlate(x, h, impl="reference"),
+                               rtol=2e-4, atol=2e-3)
+
+
+def test_overlap_save_precondition():
+    with pytest.raises(ValueError):
+        ops.convolve_initialize(100, 60, algorithm="overlap_save")
+
+
+def test_baseline_config(rng):
+    # BASELINE.md config: signal 65536, kernel 127, overlap-save path.
+    x = rng.normal(size=65536).astype(np.float32)
+    h = rng.normal(size=127).astype(np.float32)
+    got = np.asarray(ops.convolve(x, h, algorithm="overlap_save"))
+    ref = ops.convolve(x, h, impl="reference")
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-3)

@@ -1,0 +1,62 @@
+"""Measure the convolution algorithm crossovers on the attached accelerator.
+
+The reference tuned its CPU constants empirically (convolve.c:328-366:
+overlap-save when x > 2h && x > 200; FFT when x > 350 on x86 / 50 on ARM).
+This script produces the TPU equivalents feeding ops/convolve.py's
+_OS_MIN_X / _FFT_MIN_WORK policy constants.
+
+Run on a TPU host:  python tools/tune_convolve.py
+"""
+
+import time
+
+import numpy as np
+
+
+def bench(fn, iters=5):
+    """Time fn() forcing execution with a 4-byte scalar fetch per iteration.
+
+    The axon tunnel defers execution past block_until_ready, so a host fetch
+    is the only reliable fence; fetching a single element keeps the transfer
+    out of the measurement (inputs must be device-resident already).
+    """
+    float(np.asarray(fn()).ravel()[0])  # compile + warm
+    t0 = time.perf_counter()
+    acc = 0.0
+    for _ in range(iters):
+        acc += float(np.asarray(fn().ravel()[0]))
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    import jax
+
+    from veles.simd_tpu import ops
+
+    print("backend:", jax.default_backend())
+    rng = np.random.default_rng(0)
+    grid_x = [1024, 16384, 65536, 262144]
+    grid_h = [127, 2047]
+    print(f"{'x':>8} {'h':>6} {'direct':>10} {'fft':>10} {'overlap':>10}  best")
+    for x_len in grid_x:
+        for h_len in grid_h:
+            if h_len * 4 > x_len:
+                continue
+            x = jax.device_put(rng.normal(size=x_len).astype(np.float32))
+            h = jax.device_put(rng.normal(size=h_len).astype(np.float32))
+            times = {}
+            for alg in ("direct", "fft", "overlap_save"):
+                try:
+                    times[alg] = bench(
+                        lambda a=alg: ops.convolve(x, h, algorithm=a))
+                except ValueError:
+                    times[alg] = float("nan")
+            best = min(times, key=lambda k: times[k])
+            print(f"{x_len:>8} {h_len:>6} "
+                  f"{times['direct']*1e3:>9.3f}ms {times['fft']*1e3:>9.3f}ms "
+                  f"{times['overlap_save']*1e3:>9.3f}ms  {best}")
+
+
+if __name__ == "__main__":
+    main()

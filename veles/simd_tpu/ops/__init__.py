@@ -14,3 +14,11 @@ from veles.simd_tpu.ops.arithmetic import (  # noqa: F401
 from veles.simd_tpu.ops.mathfun import cos_psv, exp_psv, log_psv, sin_psv  # noqa: F401
 from veles.simd_tpu.ops.matrix import (  # noqa: F401
     matrix_add, matrix_multiply, matrix_multiply_transposed, matrix_sub)
+from veles.simd_tpu.ops.convolve import (  # noqa: F401
+    ConvolutionHandle, convolve, convolve_fft, convolve_finalize,
+    convolve_initialize, convolve_overlap_save, convolve_simd,
+    select_algorithm)
+from veles.simd_tpu.ops.correlate import (  # noqa: F401
+    cross_correlate, cross_correlate_fft, cross_correlate_finalize,
+    cross_correlate_initialize, cross_correlate_overlap_save,
+    cross_correlate_simd)

@@ -1,0 +1,210 @@
+"""1-D convolution: direct, full-FFT, and overlap-save (convolve.c reborn).
+
+All three algorithms compute the full linear convolution (length x+h-1):
+
+* ``direct``       — the brute-force path (convolve.c:40-101). On TPU this
+  is one lax.conv_general_dilated call; the MXU eats small-kernel dots.
+* ``fft``          — pad to M = next_pow2(x+h-1), batched rfft of {x, h},
+  pointwise complex product, irfft (convolve.c:231-326 minus the FFTF
+  dependency — XLA owns the FFT).
+* ``overlap_save`` — block FFT convolution with block size
+  L = ~4*next_pow2(h) and step L-(h-1) (convolve.c:103-229). The reference
+  processes blocks serially because its FFT plan shares one scratch buffer
+  (convolve.c:179-180); here every block runs in parallel as one batched
+  FFT — the TPU-native schedule, and the block decomposition that later
+  shards across devices (parallel/overlap_save_map).
+
+``convolve_initialize`` plays the reference's handle role: it picks the
+algorithm from the shapes and returns a callable handle specialized on them
+(handles = jitted closures with baked shapes). ``convolve_finalize`` exists
+for API parity and is a no-op — XLA owns plan/buffer lifetimes.
+
+Algorithm thresholds: the reference's empirical crossovers (x > 2h && x >
+200 -> overlap-save; x > 350 -> FFT, convolve.c:328-366) are CPU constants.
+The TPU constants below are initial estimates based on the MXU/VPU handling
+direct convolution far longer than CPU brute force; re-tune with
+tools/tune_convolve.py on TPU hardware and record the measured table here.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.reference import convolve as _ref
+from veles.simd_tpu.shapes import (fft_convolution_length,
+                                   overlap_save_fft_length)
+
+ALGORITHMS = ("direct", "fft", "overlap_save")
+
+# TPU crossover policy (structure mirrors convolve.c:328-366; constants are
+# TPU-measured, see tools/tune_convolve.py): direct convolution on the
+# MXU/VPU stays competitive far longer than CPU brute force, so the FFT
+# paths only win once the h*x work is substantial.
+_OS_MIN_X = 8192        # overlap-save needs x >> h and enough blocks to batch
+_FFT_MIN_WORK = 1 << 22  # x*h above which full-FFT beats direct
+
+
+def select_algorithm(x_length: int, h_length: int) -> str:
+    """Shape-driven algorithm choice (the convolve_initialize policy)."""
+    if x_length > 2 * h_length and x_length > _OS_MIN_X:
+        return "overlap_save"
+    if x_length * h_length > _FFT_MIN_WORK:
+        return "fft"
+    return "direct"
+
+
+# ---------------------------------------------------------------------------
+# direct (brute force) — lax.conv_general_dilated
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("reverse",))
+def _convolve_direct_xla(x, h, reverse=False):
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if not reverse:
+        h = h[::-1]  # conv_general_dilated correlates; flip for convolution
+    n, m = x.shape[-1], h.shape[-1]
+    lhs = x.reshape(1, 1, n)
+    rhs = h.reshape(1, 1, m)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)],
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    return out.reshape(n + m - 1)
+
+
+# ---------------------------------------------------------------------------
+# full FFT
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("fft_length", "out_length", "reverse"))
+def _convolve_fft_xla(x, h, fft_length, out_length, reverse=False):
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if reverse:
+        h = h[::-1]
+    # Batched forward transform of {x, h} — the fftf_init_batch analogue
+    # (convolve.c:264-268).
+    stacked = jnp.stack([
+        jnp.pad(x, (0, fft_length - x.shape[-1])),
+        jnp.pad(h, (0, fft_length - h.shape[-1])),
+    ])
+    spectra = jnp.fft.rfft(stacked, axis=-1)
+    out = jnp.fft.irfft(spectra[0] * spectra[1], n=fft_length)
+    return out[:out_length].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# overlap-save
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("L", "out_length", "reverse"))
+def _convolve_overlap_save_xla(x, h, L, out_length, reverse=False):
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if reverse:
+        h = h[::-1]
+    m = h.shape[-1]
+    step = L - (m - 1)
+    n_blocks = -(-out_length // step)
+    # X = [zeros(M-1), x, zeros(...)] — the index arithmetic of
+    # convolve.c:181-228 becomes one gather of overlapping windows.
+    padded = jnp.pad(x, (m - 1, n_blocks * step + L - (m - 1) - x.shape[-1]))
+    idx = jnp.arange(n_blocks)[:, None] * step + jnp.arange(L)[None, :]
+    blocks = padded[idx]                              # (n_blocks, L)
+    H = jnp.fft.rfft(jnp.pad(h, (0, L - m)))
+    spectra = jnp.fft.rfft(blocks, axis=-1)           # batched: all blocks
+    conv = jnp.fft.irfft(spectra * H[None, :], n=L, axis=-1)
+    useful = conv[:, m - 1:]                          # step samples per block
+    return useful.reshape(-1)[:out_length].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ConvolutionHandle:
+    """Shape-specialized convolution closure (the reference's handle triple).
+
+    Mirrors ConvolutionHandle (convolve_structs.h:39-74): algorithm chosen at
+    initialize time from (x_length, h_length); calling the handle runs it.
+    ``reverse`` is the cross-correlation flag (set by correlate.py, the
+    analogue of handle.reverse=1 in cross_correlate_initialize).
+    """
+
+    x_length: int
+    h_length: int
+    algorithm: str
+    reverse: bool = False
+    _fn: Callable = field(repr=False, default=None)
+
+    def __call__(self, x, h):
+        x = jnp.asarray(x)
+        h = jnp.asarray(h)
+        if x.shape[-1] != self.x_length or h.shape[-1] != self.h_length:
+            raise ValueError(
+                f"handle is specialized for x_length={self.x_length}, "
+                f"h_length={self.h_length}; got {x.shape[-1]}, {h.shape[-1]}")
+        return self._fn(x, h)
+
+
+def convolve_initialize(x_length: int, h_length: int,
+                        algorithm: Optional[str] = None,
+                        reverse: bool = False) -> ConvolutionHandle:
+    """Pick an algorithm for the shapes and build the specialized closure."""
+    if x_length <= 0 or h_length <= 0:
+        raise ValueError("x_length and h_length must be positive")
+    if algorithm is None:
+        algorithm = select_algorithm(x_length, h_length)
+    if algorithm not in ALGORITHMS:
+        raise ValueError(f"algorithm must be one of {ALGORITHMS}")
+    out_length = x_length + h_length - 1
+    if algorithm == "direct":
+        fn = functools.partial(_convolve_direct_xla, reverse=reverse)
+    elif algorithm == "fft":
+        fft_length = fft_convolution_length(x_length, h_length)
+        fn = functools.partial(_convolve_fft_xla, fft_length=fft_length,
+                               out_length=out_length, reverse=reverse)
+    else:
+        if h_length >= x_length / 2:
+            raise ValueError(
+                "overlap_save requires h_length < x_length / 2 "
+                "(convolve.c:105 assert)")
+        L = overlap_save_fft_length(h_length)
+        fn = functools.partial(_convolve_overlap_save_xla, L=L,
+                               out_length=out_length, reverse=reverse)
+    return ConvolutionHandle(x_length, h_length, algorithm, reverse, fn)
+
+
+def convolve_finalize(handle: ConvolutionHandle) -> None:
+    """API-parity no-op: XLA owns FFT plan and buffer lifetimes."""
+
+
+def convolve(x, h, *, algorithm: Optional[str] = None, impl=None):
+    """Full linear convolution, length x+h-1 (one-shot form)."""
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _ref.convolve(x, h)
+    x = jnp.asarray(x)
+    h = jnp.asarray(h)
+    handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm)
+    return handle(x, h)
+
+
+def convolve_simd(x, h, *, impl=None):
+    """Brute-force path parity alias (convolve.h:112-125)."""
+    return convolve(x, h, algorithm="direct", impl=impl)
+
+
+def convolve_fft(x, h, *, impl=None):
+    return convolve(x, h, algorithm="fft", impl=impl)
+
+
+def convolve_overlap_save(x, h, *, impl=None):
+    return convolve(x, h, algorithm="overlap_save", impl=impl)

@@ -1,0 +1,54 @@
+"""1-D cross-correlation as reversed convolution (correlate.c reborn).
+
+The reference delegates every FFT-family correlation to convolve with
+``handle.reverse = 1`` (correlate.c:128-142; the reversal happens via
+rmemcpyf at convolve.c:167-171, 302-306) and keeps a dedicated brute-force
+kernel that skips the reversal (correlate.c:74-126). Here the reverse flag
+threads through the same three algorithm closures.
+
+result[j] = sum_m x[m] * h[m + (hLength-1) - j], length x+h-1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from veles.simd_tpu.config import resolve_impl
+# Import names directly: module-object access through the ops package would
+# hit the re-exported convolve *function* (ops/__init__.py), not the module.
+from veles.simd_tpu.ops.convolve import ConvolutionHandle, convolve_initialize
+from veles.simd_tpu.reference import correlate as _ref
+
+
+def cross_correlate_initialize(x_length: int, h_length: int,
+                               algorithm: Optional[str] = None
+                               ) -> ConvolutionHandle:
+    return convolve_initialize(x_length, h_length, algorithm, reverse=True)
+
+
+def cross_correlate_finalize(handle) -> None:
+    """API-parity no-op."""
+
+
+def cross_correlate(x, h, *, algorithm: Optional[str] = None, impl=None):
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _ref.cross_correlate(x, h)
+    x = jnp.asarray(x)
+    h = jnp.asarray(h)
+    handle = cross_correlate_initialize(x.shape[-1], h.shape[-1], algorithm)
+    return handle(x, h)
+
+
+def cross_correlate_simd(x, h, *, impl=None):
+    return cross_correlate(x, h, algorithm="direct", impl=impl)
+
+
+def cross_correlate_fft(x, h, *, impl=None):
+    return cross_correlate(x, h, algorithm="fft", impl=impl)
+
+
+def cross_correlate_overlap_save(x, h, *, impl=None):
+    return cross_correlate(x, h, algorithm="overlap_save", impl=impl)
