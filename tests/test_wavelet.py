@@ -1,0 +1,227 @@
+"""Wavelet engine tests.
+
+Mirrors the reference test strategy (tests/wavelet.cc): golden vectors for
+db8 on a ramp signal (tests/wavelet.cc:88-167 values reused verbatim as
+ground truth), differential impl-vs-oracle sweeps over
+{type} x {order} x {extension} x {length} (tests/wavelet.cc:252-288), and
+the multi-level cascade protocol.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import wavelet as W
+from veles.simd_tpu.reference import wavelet as ref_wavelet
+
+# Golden vectors from tests/wavelet.cc:96-153: db8, periodic extension,
+# src = [0, 1, ..., 31].
+RAMP32 = np.arange(32, dtype=np.float64)
+
+GOLD_DWT_LO = np.array([
+    1.42184071797210, 4.25026784271829, 7.07869496746448, 9.90712209221067,
+    12.7355492169569, 15.5639763417030, 18.3924034664492, 21.2208305911954,
+    24.0492577159416, 26.8776848406878, 29.7061119654340, 32.5345390901802,
+    35.3629662149264, 37.4782538234490, 45.3048707044478, 28.8405938767906])
+
+GOLD_DWT_HI = np.array([
+    -9.91075277401166e-13, -9.90367510222967e-13, -9.90194037875369e-13,
+    -9.91873250200115e-13, -9.91456916565880e-13, -9.91096094082877e-13,
+    -9.90263426814408e-13, -9.89069937062936e-13, -9.91706716746421e-13,
+    -9.92234072683118e-13, -9.92872450922278e-13, -9.91484672141496e-13,
+    -9.88431558823777e-13, -15.5030002317990, 5.58066496329142,
+    -1.39137323046436])
+
+GOLD_SWT_HI1 = np.array([
+    -9.91075277401166e-13, -9.90107301701571e-13, -9.90367510222967e-13,
+    -9.90624249297412e-13, -9.90194037875369e-13, -9.91373649839034e-13,
+    -9.91873250200115e-13, -9.91193238597532e-13, -9.91456916565880e-13,
+    -9.89944237694829e-13, -9.91096094082877e-13, -9.90901805053568e-13,
+    -9.90263426814408e-13, -9.91484672141496e-13, -9.89069937062936e-13,
+    -9.91901005775731e-13, -9.91706716746421e-13, -9.88847892458011e-13,
+    -9.92234072683118e-13, -9.91595694443959e-13, -9.92872450922278e-13,
+    -9.94343496429906e-13, -9.91484672141496e-13, -9.91318138687802e-13,
+    -9.88431558823777e-13, 7.37209002588238, -15.5030002317990,
+    4.68518434194794, 5.58066496329142, -0.404449011712775,
+    -1.39137323046436, -0.339116857120903])
+
+GOLD_SWT_HI2 = np.array([
+    -2.80091227988777e-12, -2.79960776783383e-12, -2.80357681514687e-12,
+    -2.80355599846516e-12, -2.80095391325119e-12, -2.79949674553137e-12,
+    -2.79951062331918e-12, -2.80001022368026e-12, -2.80267475893936e-12,
+    -2.79856693374825e-12, -2.80492296056423e-12, -0.0781250000022623,
+    0.164291522328916, 0.634073488075181, -1.49696584171718,
+    -2.62270640553024, 6.97048991951669, 13.4936761845669,
+    -2.98585954495631, -19.8119363515072, -12.7098068594040,
+    1.52245837263813, 7.82528131630407, 8.59130932663576, 5.24090543738087,
+    1.01894438076528, -1.16818198731391, -1.89266864772546,
+    -1.51961243979140, -0.776900347899835, -0.320541522330983,
+    -0.0781250000022604])
+
+GOLD_SWT_LO2 = np.array([
+    6.03235928067132, 8.03235928067132, 10.0323592806713, 12.0323592806713,
+    14.0323592806713, 16.0323592806713, 18.0323592806713, 20.0323592806713,
+    22.0323592806713, 24.0323592806713, 26.0323592806713, 28.0287655230843,
+    30.0399167066535, 32.0615267227001, 33.9634987065767, 35.9320147305194,
+    38.3103125658258, 40.4883104236778, 42.2839848729069, 43.7345002903498,
+    43.7794736932925, 45.1480484137191, 49.8652419127137, 55.7384062022009,
+    62.7058766150960, 65.2835749751486, 58.7895581326311, 46.7708694321525,
+    31.0673425771182, 16.9214616227404, 9.00063853315767, 5.73072526035035])
+
+SWEEP = [(t, o) for t in ("daubechies", "symlet") for o in (2, 4, 6, 8, 12, 16)]
+SWEEP += [("coiflet", 6), ("coiflet", 12)]
+
+
+class TestGolden:
+    def test_dwt_reference_oracle(self):
+        hi, lo = ref_wavelet.wavelet_apply(RAMP32, "daubechies", 8, "periodic")
+        np.testing.assert_allclose(lo, GOLD_DWT_LO, rtol=1e-10)
+        np.testing.assert_allclose(hi, GOLD_DWT_HI, atol=1e-10)
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_dwt_tpu(self, impl):
+        hi, lo = W.wavelet_apply(RAMP32, "daubechies", 8, "periodic",
+                                 impl=impl)
+        np.testing.assert_allclose(np.asarray(lo), GOLD_DWT_LO,
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(hi), GOLD_DWT_HI, atol=1e-4)
+
+    def test_swt_cascade_reference_oracle(self):
+        hi1, lo1 = ref_wavelet.stationary_wavelet_apply(
+            RAMP32, "daubechies", 8, 1, "periodic")
+        hi2, lo2 = ref_wavelet.stationary_wavelet_apply(
+            lo1, "daubechies", 8, 2, "periodic")
+        np.testing.assert_allclose(hi1, GOLD_SWT_HI1, atol=1e-10)
+        np.testing.assert_allclose(hi2, GOLD_SWT_HI2, atol=1e-9)
+        np.testing.assert_allclose(lo2, GOLD_SWT_LO2, rtol=1e-10)
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_swt_cascade_tpu(self, impl):
+        hi1, lo1 = W.stationary_wavelet_apply(RAMP32, "daubechies", 8, 1,
+                                              "periodic", impl=impl)
+        hi2, lo2 = W.stationary_wavelet_apply(lo1, "daubechies", 8, 2,
+                                              "periodic", impl=impl)
+        np.testing.assert_allclose(np.asarray(hi1), GOLD_SWT_HI1, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(hi2), GOLD_SWT_HI2, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(lo2), GOLD_SWT_LO2,
+                                   rtol=1e-5, atol=2e-4)
+
+
+class TestDifferential:
+    """impl-vs-oracle, the reference's SIMD-vs-_na pattern
+    (tests/wavelet.cc:224-250, epsilon 0.0005)."""
+
+    @pytest.mark.parametrize("wavelet_type,order", SWEEP)
+    @pytest.mark.parametrize("ext", ref_wavelet.EXTENSION_TYPES)
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_dwt(self, rng, wavelet_type, order, ext, impl):
+        src = rng.normal(size=130).astype(np.float32)
+        want_hi, want_lo = ref_wavelet.wavelet_apply(src, wavelet_type, order,
+                                                     ext)
+        hi, lo = W.wavelet_apply(src, wavelet_type, order, ext, impl=impl)
+        np.testing.assert_allclose(np.asarray(hi), want_hi, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo), want_lo, atol=5e-4)
+
+    @pytest.mark.parametrize("wavelet_type,order",
+                             [("daubechies", 8), ("symlet", 4),
+                              ("coiflet", 6), ("daubechies", 16)])
+    @pytest.mark.parametrize("level", [1, 2, 3, 4])
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_swt(self, rng, wavelet_type, order, level, impl):
+        src = rng.normal(size=96).astype(np.float32)
+        want_hi, want_lo = ref_wavelet.stationary_wavelet_apply(
+            src, wavelet_type, order, level, "periodic")
+        hi, lo = W.stationary_wavelet_apply(src, wavelet_type, order, level,
+                                            "periodic", impl=impl)
+        np.testing.assert_allclose(np.asarray(hi), want_hi, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo), want_lo, atol=5e-4)
+
+    @pytest.mark.parametrize("length", [2, 4, 6, 18])
+    def test_short_signals(self, rng, length):
+        """Signals shorter than the filter: the extension covers the
+        overhang (check_length semantics, src/wavelet.c:49-52)."""
+        src = rng.normal(size=length).astype(np.float32)
+        want_hi, want_lo = ref_wavelet.wavelet_apply(src, "daubechies", 8,
+                                                     "periodic")
+        hi, lo = W.wavelet_apply(src, "daubechies", 8, "periodic", impl="xla")
+        np.testing.assert_allclose(np.asarray(hi), want_hi, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo), want_lo, atol=5e-4)
+
+
+class TestBatch:
+    def test_batched_matches_loop(self, rng):
+        batch = rng.normal(size=(5, 64)).astype(np.float32)
+        hi, lo = W.wavelet_apply(batch, "daubechies", 8, "mirror", impl="xla")
+        assert hi.shape == lo.shape == (5, 32)
+        for i in range(5):
+            want_hi, want_lo = ref_wavelet.wavelet_apply(batch[i],
+                                                         "daubechies", 8,
+                                                         "mirror")
+            np.testing.assert_allclose(np.asarray(hi[i]), want_hi, atol=5e-4)
+            np.testing.assert_allclose(np.asarray(lo[i]), want_lo, atol=5e-4)
+
+    def test_batched_pallas(self, rng):
+        batch = rng.normal(size=(3, 64)).astype(np.float32)
+        hi_x, lo_x = W.wavelet_apply(batch, "daubechies", 4, impl="xla")
+        hi_p, lo_p = W.wavelet_apply(batch, "daubechies", 4, impl="pallas")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
+                                   atol=1e-5)
+
+
+class TestCascade:
+    def test_dwt_decompose(self, rng):
+        src = rng.normal(size=256).astype(np.float32)
+        details, approx = W.wavelet_decompose(src, 3, "daubechies", 8,
+                                              impl="xla")
+        assert [d.shape[-1] for d in details] == [128, 64, 32]
+        assert approx.shape[-1] == 32
+        lo = src
+        for k in range(3):
+            want_hi, lo = ref_wavelet.wavelet_apply(lo, "daubechies", 8,
+                                                    "periodic")
+            np.testing.assert_allclose(np.asarray(details[k]), want_hi,
+                                       atol=5e-4)
+        np.testing.assert_allclose(np.asarray(approx), lo, atol=5e-4)
+
+    def test_swt_decompose_full_length(self, rng):
+        src = rng.normal(size=64).astype(np.float32)
+        details, approx = W.stationary_wavelet_decompose(src, 4, "daubechies",
+                                                         8, impl="xla")
+        assert all(d.shape[-1] == 64 for d in details)
+        assert approx.shape[-1] == 64
+
+    def test_decompose_validates(self):
+        with pytest.raises(ValueError):
+            W.wavelet_decompose(np.zeros(48, np.float32), 5)  # 48 % 32 != 0
+
+
+class TestContracts:
+    def test_odd_length_rejected(self):
+        with pytest.raises(ValueError):
+            W.wavelet_apply(np.zeros(31, np.float32), impl="xla")
+
+    def test_bad_order_rejected(self):
+        with pytest.raises(ValueError):
+            W.wavelet_apply(np.zeros(32, np.float32), "daubechies", 7,
+                            impl="xla")
+        with pytest.raises(ValueError):
+            W.wavelet_apply(np.zeros(32, np.float32), "coiflet", 8,
+                            impl="xla")
+
+    def test_validate_order(self):
+        assert W.wavelet_validate_order("daubechies", 8)
+        assert W.wavelet_validate_order("coiflet", 30)
+        assert not W.wavelet_validate_order("coiflet", 32)
+        assert not W.wavelet_validate_order("daubechies", 78)
+
+    def test_buffer_shims(self):
+        src = np.arange(16, dtype=np.float32)
+        prepared = W.wavelet_prepare_array(8, src, 16)
+        np.testing.assert_array_equal(prepared, src)
+        dest = W.wavelet_allocate_destination(8, 16)
+        assert dest.shape == (8,)
+        quarters = W.wavelet_recycle_source(8, src)
+        assert len(quarters) == 4
+        assert all(q.shape == (4,) for q in quarters)
+        assert W.wavelet_recycle_source(8, np.zeros(6)) == (None,) * 4

@@ -18,6 +18,11 @@ from veles.simd_tpu.ops.convolve import (  # noqa: F401
     ConvolutionHandle, convolve, convolve_fft, convolve_finalize,
     convolve_initialize, convolve_overlap_save, convolve_simd,
     select_algorithm)
+from veles.simd_tpu.ops.wavelet import (  # noqa: F401
+    EXTENSION_CONSTANT, EXTENSION_MIRROR, EXTENSION_PERIODIC, EXTENSION_TYPES,
+    EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,
+    wavelet_allocate_destination, wavelet_apply, wavelet_decompose,
+    wavelet_prepare_array, wavelet_recycle_source, wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate_fft, cross_correlate_finalize,
     cross_correlate_initialize, cross_correlate_overlap_save,

@@ -43,9 +43,10 @@ from veles.simd_tpu.shapes import (fft_convolution_length,
 ALGORITHMS = ("direct", "fft", "overlap_save")
 
 # TPU crossover policy (structure mirrors convolve.c:328-366; constants are
-# TPU-measured, see tools/tune_convolve.py): direct convolution on the
-# MXU/VPU stays competitive far longer than CPU brute force, so the FFT
-# paths only win once the h*x work is substantial.
+# initial estimates pending measurement with tools/tune_convolve.py — see
+# module docstring): direct convolution on the MXU/VPU stays competitive far
+# longer than CPU brute force, so the FFT paths only win once the h*x work
+# is substantial.
 _OS_MIN_X = 8192        # overlap-save needs x >> h and enough blocks to batch
 _FFT_MIN_WORK = 1 << 22  # x*h above which full-FFT beats direct
 

@@ -1,0 +1,137 @@
+"""Fused wavelet filter-bank Pallas kernels (VPU).
+
+The reference's hot DWT loop computes the highpass and lowpass outputs in
+one pass over each stride-2 window — two dot products sharing every load
+(src/wavelet.c:1063-1074, the dual `_mm256_dp_ps` idiom). These kernels
+keep that fusion on the TPU VPU: one traversal of the signal produces both
+sub-bands, so the signal streams from VMEM exactly once.
+
+Layout: instead of the reference's `wavelet_prepare_array` replication trick
+(src/wavelet.c:64-81, which exists only to make stride-2 windows aligned
+32-byte loads), the signal is de-interleaved into even/odd phase planes
+outside the kernel. Every tap then becomes a *unit-stride* shifted slice of
+a phase plane — the natural vector layout for the (8, 128) VPU, with no
+replication and no strided loads:
+
+    out[d] = sum_k f[2k] * even[d + k] + f[2k+1] * odd[d + k]
+
+Filter taps are static Python floats baked into the kernel at trace time
+(they are compile-time constants per (type, order), exactly as the
+reference's coefficient tables are baked into specialized kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from veles.simd_tpu.pallas import use_interpret
+
+_LANES = 128
+
+
+def _pad_to(x, length):
+    """Pad (or trim) the last axis to exactly ``length`` samples."""
+    if x.shape[-1] >= length:
+        return x[..., :length]
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, length - x.shape[-1])])
+
+
+def _dwt_kernel(even_ref, odd_ref, hi_ref, lo_ref, *, taps_hi, taps_lo,
+                out_len):
+    even = even_ref[...]
+    odd = odd_ref[...]
+    half_taps = len(taps_hi) // 2
+    acc_hi = jnp.zeros((1, out_len), jnp.float32)
+    acc_lo = jnp.zeros((1, out_len), jnp.float32)
+    for k in range(half_taps):
+        # tap offsets are trace-time constants -> static slices
+        e = even[:, k:k + out_len]
+        o = odd[:, k:k + out_len]
+        acc_hi = acc_hi + taps_hi[2 * k] * e + taps_hi[2 * k + 1] * o
+        acc_lo = acc_lo + taps_lo[2 * k] * e + taps_lo[2 * k + 1] * o
+    hi_ref[...] = acc_hi
+    lo_ref[...] = acc_lo
+
+
+@functools.partial(jax.jit, static_argnames=("taps_hi", "taps_lo"))
+def _dwt_call(x_ext, taps_hi, taps_lo):
+    order = len(taps_hi)
+    n = x_ext.shape[-1] - order
+    half = n // 2
+    # De-interleave into phase planes: x[2d + 2k] = even[d+k],
+    # x[2d + 2k + 1] = odd[d+k].
+    phases = x_ext.reshape(-1, 2)
+    out_pad = -half % _LANES
+    in_len = half + out_pad + order // 2
+    even = _pad_to(phases[:, 0].reshape(1, -1), in_len)
+    odd = _pad_to(phases[:, 1].reshape(1, -1), in_len)
+    kernel = functools.partial(_dwt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
+                               out_len=half + out_pad)
+    hi, lo = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((1, half + out_pad), jnp.float32)] * 2,
+        interpret=use_interpret(),
+    )(even, odd)
+    return hi[0, :half], lo[0, :half]
+
+
+def dwt_filter_bank(x_ext, hi_taps, lo_taps):
+    """Decimated filter bank over an already-extended signal.
+
+    ``x_ext`` has shape (n + order,); returns (hi, lo) of length n/2 with
+    out[d] = sum_j f[j] * x_ext[2d + j] (correlation form, as
+    wavelet_apply_na src/wavelet.c:270-322).
+    """
+    x_ext = jnp.asarray(x_ext, jnp.float32)
+    taps_hi = tuple(float(t) for t in np.asarray(hi_taps))
+    taps_lo = tuple(float(t) for t in np.asarray(lo_taps))
+    return _dwt_call(x_ext, taps_hi, taps_lo)
+
+
+def _swt_kernel(x_ref, hi_ref, lo_ref, *, taps_hi, taps_lo, stride, out_len):
+    x = x_ref[...]
+    acc_hi = jnp.zeros((1, out_len), jnp.float32)
+    acc_lo = jnp.zeros((1, out_len), jnp.float32)
+    for k in range(len(taps_hi)):
+        w = x[:, k * stride:k * stride + out_len]
+        acc_hi = acc_hi + taps_hi[k] * w
+        acc_lo = acc_lo + taps_lo[k] * w
+    hi_ref[...] = acc_hi
+    lo_ref[...] = acc_lo
+
+
+@functools.partial(jax.jit, static_argnames=("taps_hi", "taps_lo", "stride",
+                                             "out_length"))
+def _swt_call(x_ext, taps_hi, taps_lo, stride, out_length):
+    out_pad = -out_length % _LANES
+    in_len = out_length + out_pad + (len(taps_hi) - 1) * stride
+    x = _pad_to(x_ext.reshape(1, -1), in_len)
+    kernel = functools.partial(_swt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
+                               stride=stride, out_len=out_length + out_pad)
+    hi, lo = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((1, out_length + out_pad),
+                                        jnp.float32)] * 2,
+        interpret=use_interpret(),
+    )(x)
+    return hi[0, :out_length], lo[0, :out_length]
+
+
+def swt_filter_bank(x_ext, hi_taps, lo_taps, stride, out_length):
+    """Stationary (à-trous) filter bank over an extended signal.
+
+    Applies the *base* ``order``-tap filters at dilation ``stride`` with unit
+    output stride: out[t] = sum_k f[k] * x_ext[t + k*stride] — equivalent to
+    the reference's zero-stuffed dilated filters
+    (stationary_wavelet_apply_na, src/wavelet.c:324-381) without ever
+    materializing the zeros.
+    """
+    x_ext = jnp.asarray(x_ext, jnp.float32)
+    taps_hi = tuple(float(t) for t in np.asarray(hi_taps))
+    taps_lo = tuple(float(t) for t in np.asarray(lo_taps))
+    return _swt_call(x_ext, taps_hi, taps_lo, int(stride), int(out_length))
