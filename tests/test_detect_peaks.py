@@ -1,0 +1,110 @@
+"""Peak detection tests (mirrors tests/detect_peaks.cc).
+
+Golden patterns: sine extrema at known positions (detect_peaks.cc:43-76) and
+adjacent "nasty" peaks (detect_peaks.cc:78-98); differential vs the oracle;
+the fixed-capacity jittable form with batching.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops as D
+from veles.simd_tpu.reference import detect_peaks as ref
+
+IMPLS = ["reference", "xla"]
+
+
+class TestGolden:
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_sine_maxima(self, impl):
+        """sin over 3 periods: maxima at 100, 500, 900 (period 400)."""
+        i = np.arange(1200)
+        data = np.sin(i * np.pi / 200).astype(np.float32)
+        pos, val = D.detect_peaks(data, D.EXTREMUM_TYPE_MAXIMUM, impl=impl)
+        np.testing.assert_array_equal(pos, [100, 500, 900])
+        np.testing.assert_allclose(val, 1.0, atol=1e-5)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_sine_both(self, impl):
+        i = np.arange(1200)
+        data = np.sin(i * np.pi / 200).astype(np.float32)
+        pos, val = D.detect_peaks(data, D.EXTREMUM_TYPE_BOTH, impl=impl)
+        np.testing.assert_array_equal(pos, [100, 300, 500, 700, 900, 1100])
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_adjacent_nasty_peaks(self, impl):
+        """Alternating saw: every interior point is a strict extremum."""
+        data = np.array([0, 1, 0, 1, 0, 1, 0], np.float32)
+        pos, _ = D.detect_peaks(data, D.EXTREMUM_TYPE_MAXIMUM, impl=impl)
+        np.testing.assert_array_equal(pos, [1, 3, 5])
+        pos, _ = D.detect_peaks(data, D.EXTREMUM_TYPE_MINIMUM, impl=impl)
+        np.testing.assert_array_equal(pos, [2, 4])
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_plateau_is_not_a_peak(self, impl):
+        data = np.array([0, 1, 1, 0, 2, 0], np.float32)
+        # plateau points 1, 2 are excluded; 3 is a strict min, 4 a strict max
+        pos, _ = D.detect_peaks(data, D.EXTREMUM_TYPE_BOTH, impl=impl)
+        np.testing.assert_array_equal(pos, [3, 4])
+        pos, _ = D.detect_peaks(data, D.EXTREMUM_TYPE_MAXIMUM, impl=impl)
+        np.testing.assert_array_equal(pos, [4])
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("extremum_type", [1, 2, 3])
+    @pytest.mark.parametrize("length", [3, 17, 256, 999])
+    def test_random(self, rng, extremum_type, length):
+        data = rng.normal(size=length).astype(np.float32)
+        want_pos, want_val = ref.detect_peaks(data, extremum_type)
+        pos, val = D.detect_peaks(data, extremum_type, impl="xla")
+        np.testing.assert_array_equal(pos, want_pos)
+        np.testing.assert_allclose(val, want_val, rtol=1e-6)
+
+
+class TestFixedCapacity:
+    def test_padding_semantics(self):
+        data = np.array([0, 1, 0, 1, 0], np.float32)  # peaks at 1, 3 (max)
+        pos, val, count = D.detect_peaks_fixed(
+            data, D.EXTREMUM_TYPE_MAXIMUM, impl="xla")
+        assert int(count) == 2
+        np.testing.assert_array_equal(np.asarray(pos), [1, 3, -1])
+        np.testing.assert_allclose(np.asarray(val), [1, 1, 0])
+
+    def test_capacity_truncates(self):
+        data = np.array([0, 1, 0, 1, 0, 1, 0], np.float32)
+        pos, val, count = D.detect_peaks_fixed(
+            data, D.EXTREMUM_TYPE_BOTH, capacity=2, impl="xla")
+        assert int(count) == 2
+        np.testing.assert_array_equal(np.asarray(pos), [1, 2])
+
+    def test_batched(self, rng):
+        batch = rng.normal(size=(6, 128)).astype(np.float32)
+        pos, val, count = D.detect_peaks_fixed(batch, impl="xla")
+        assert pos.shape == (6, 126) and count.shape == (6,)
+        for b in range(6):
+            want_pos, want_val = ref.detect_peaks(batch[b])
+            c = int(count[b])
+            assert c == len(want_pos)
+            np.testing.assert_array_equal(np.asarray(pos[b])[:c], want_pos)
+            np.testing.assert_allclose(np.asarray(val[b])[:c], want_val,
+                                       rtol=1e-6)
+
+    def test_reference_fixed_matches_xla(self, rng):
+        data = rng.normal(size=64).astype(np.float32)
+        r = D.detect_peaks_fixed(data, capacity=10, impl="reference")
+        x = D.detect_peaks_fixed(data, capacity=10, impl="xla")
+        np.testing.assert_array_equal(np.asarray(r[0]), np.asarray(x[0]))
+        np.testing.assert_allclose(np.asarray(r[1]), np.asarray(x[1]),
+                                   rtol=1e-6)
+        assert int(r[2]) == int(x[2])
+
+
+class TestContracts:
+    def test_short_input_rejected(self):
+        for impl in IMPLS:
+            with pytest.raises(ValueError):
+                D.detect_peaks(np.zeros(2, np.float32), impl=impl)
+
+    def test_batch_trim_rejected(self):
+        with pytest.raises(ValueError):
+            D.detect_peaks(np.zeros((2, 8), np.float32), impl="xla")

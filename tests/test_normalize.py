@@ -1,0 +1,100 @@
+"""Normalization tests (mirrors tests/normalize.cc patterns)."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops as N
+from veles.simd_tpu.reference import normalize as ref
+
+
+class TestGolden:
+    def test_small_plane(self):
+        """Hand-computed map: {0..255} plane -> exactly [-1, 1]."""
+        src = np.array([[0, 128], [255, 64]], np.uint8)
+        out = np.asarray(N.normalize2D(src, impl="xla"))
+        want = (src.astype(np.float32) - 0) / 127.5 - 1
+        np.testing.assert_allclose(out, want, atol=1e-6)
+        assert out.min() == -1.0 and out.max() == 1.0
+
+    def test_constant_plane_zero_fill(self):
+        src = np.full((4, 8), 77, np.uint8)
+        out = np.asarray(N.normalize2D(src, impl="xla"))
+        np.testing.assert_array_equal(out, np.zeros((4, 8), np.float32))
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("shape", [(1, 3), (7, 9), (16, 128), (33, 255)])
+    def test_normalize2D(self, rng, shape):
+        src = rng.integers(0, 256, size=shape).astype(np.uint8)
+        want = ref.normalize2D(src)
+        out = np.asarray(N.normalize2D(src, impl="xla"))
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+    def test_minmax2D(self, rng):
+        src = rng.integers(0, 256, size=(13, 27)).astype(np.uint8)
+        want = ref.minmax2D(src)
+        got = N.minmax2D(src, impl="xla")
+        assert (int(got[0]), int(got[1])) == (int(want[0]), int(want[1]))
+
+    @pytest.mark.parametrize("length", [1, 3, 64, 199])
+    def test_minmax1D(self, rng, length):
+        src = rng.normal(size=length).astype(np.float32)
+        want = ref.minmax1D(src)
+        got = N.minmax1D(src, impl="xla")
+        np.testing.assert_allclose([float(got[0]), float(got[1])],
+                                   [want[0], want[1]], rtol=1e-6)
+
+    def test_normalize2D_minmax_split(self, rng):
+        """Two-pass API split matches the fused path (normalize.c:435-441)."""
+        src = rng.integers(0, 256, size=(9, 31)).astype(np.uint8)
+        vmin, vmax = N.minmax2D(src, impl="xla")
+        out = np.asarray(N.normalize2D_minmax(vmin, vmax, src, impl="xla"))
+        np.testing.assert_allclose(out, ref.normalize2D(src), atol=1e-5)
+
+
+class TestNormalize1D:
+    def test_differential(self, rng):
+        src = rng.normal(size=(4, 130)).astype(np.float32)
+        out = np.asarray(N.normalize1D(src, impl="xla"))
+        want = ref.normalize1D(src)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+        assert out.min() >= -1 and out.max() <= 1
+
+    def test_constant_signal_zero_fills(self):
+        src = np.full(17, 3.5, np.float32)
+        for impl in ("reference", "xla"):
+            np.testing.assert_array_equal(
+                np.asarray(N.normalize1D(src, impl=impl)), np.zeros(17))
+
+
+class TestJitComposability:
+    def test_minmax_normalize_pair_under_jit(self, rng):
+        """The two-pass API split must fuse under one jit
+        (the stated point of the split)."""
+        import jax
+
+        src = rng.integers(0, 256, size=(6, 9)).astype(np.uint8)
+        fused = jax.jit(
+            lambda s: N.normalize2D_minmax(*N.minmax2D(s, impl="xla"), s,
+                                           impl="xla"))
+        np.testing.assert_allclose(np.asarray(fused(src)),
+                                   ref.normalize2D(src), atol=1e-5)
+
+
+class TestBatch:
+    def test_batched_planes(self, rng):
+        batch = rng.integers(0, 256, size=(5, 8, 16)).astype(np.uint8)
+        out = np.asarray(N.normalize2D(batch, impl="xla"))
+        assert out.shape == (5, 8, 16)
+        for i in range(5):
+            np.testing.assert_allclose(out[i], ref.normalize2D(batch[i]),
+                                       atol=1e-5)
+
+
+class TestContracts:
+    def test_min_gt_max_rejected(self):
+        with pytest.raises(ValueError):
+            N.normalize2D_minmax(10, 5, np.zeros((2, 2), np.uint8),
+                                 impl="xla")
+        with pytest.raises(ValueError):
+            ref.normalize2D_minmax(10, 5, np.zeros((2, 2), np.uint8))

@@ -18,6 +18,11 @@ from veles.simd_tpu.ops.convolve import (  # noqa: F401
     ConvolutionHandle, convolve, convolve_fft, convolve_finalize,
     convolve_initialize, convolve_overlap_save, convolve_simd,
     select_algorithm)
+from veles.simd_tpu.ops.normalize import (  # noqa: F401
+    minmax1D, minmax2D, normalize1D, normalize2D, normalize2D_minmax)
+from veles.simd_tpu.ops.detect_peaks import (  # noqa: F401
+    EXTREMUM_TYPE_BOTH, EXTREMUM_TYPE_MAXIMUM, EXTREMUM_TYPE_MINIMUM,
+    detect_peaks, detect_peaks_fixed)
 from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_CONSTANT, EXTENSION_MIRROR, EXTENSION_PERIODIC, EXTENSION_TYPES,
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,

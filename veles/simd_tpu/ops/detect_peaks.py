@@ -1,0 +1,103 @@
+"""Peak detection (src/detect_peaks.c reborn).
+
+A point at interior index i is an extremum when
+(x[i] - x[i-1]) * (x[i] - x[i+1]) > 0 — strict local max/min, plateaus
+excluded (check_peak, detect_peaks.c:41-56). The type mask selects maxima
+(bit 1), minima (bit 2), or both (detect_peaks.h:40-49).
+
+The one real design change from the reference (SURVEY §7 hard part (a)):
+its output is a realloc-grown dynamic array (append_peak doubling,
+detect_peaks.c:30-39), which has no jittable analogue. The TPU-native shape
+is ``detect_peaks_fixed``: a fixed ``capacity`` with mask-and-compact
+semantics, returning (positions, values, count) where slots past ``count``
+are padded with position -1 / value 0. ``detect_peaks`` wraps it with a
+host-side trim for exact API parity with the reference's
+(ExtremumPoint*, count) result.
+
+``detect_peaks_fixed`` accepts leading batch dimensions — the compaction is
+a per-signal sort, so a (B, N) batch is one fused XLA kernel, the TPU
+answer to the reference's per-signal loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.reference import detect_peaks as _ref
+from veles.simd_tpu.reference.detect_peaks import (  # noqa: F401 (re-export)
+    EXTREMUM_TYPE_BOTH, EXTREMUM_TYPE_MAXIMUM, EXTREMUM_TYPE_MINIMUM)
+
+
+@functools.partial(jax.jit, static_argnames=("extremum_type", "capacity"))
+def _detect_peaks_fixed_xla(data, extremum_type, capacity):
+    data = jnp.asarray(data, jnp.float32)
+    d1 = data[..., 1:-1] - data[..., :-2]
+    d2 = data[..., 1:-1] - data[..., 2:]
+    strict = d1 * d2 > 0
+    sel = jnp.zeros_like(strict)
+    if extremum_type & EXTREMUM_TYPE_MAXIMUM:
+        sel = sel | (strict & (d1 > 0))
+    if extremum_type & EXTREMUM_TYPE_MINIMUM:
+        sel = sel | (strict & (d1 < 0))
+    n = data.shape[-1] - 2
+    # compaction: selected interior indices sort ahead of the sentinel n
+    idx = jnp.where(sel, jnp.arange(n), n)
+    order = jnp.sort(idx, axis=-1)[..., :capacity]
+    valid = order < n
+    positions = jnp.where(valid, order + 1, -1).astype(jnp.int32)
+    values = jnp.take_along_axis(data, jnp.clip(positions, 0), axis=-1)
+    values = jnp.where(valid, values, 0).astype(jnp.float32)
+    count = jnp.sum(sel, axis=-1).astype(jnp.int32)
+    return positions, values, jnp.minimum(count, capacity)
+
+
+def detect_peaks_fixed(data, extremum_type=EXTREMUM_TYPE_BOTH, *,
+                       capacity=None, impl=None):
+    """Jittable fixed-capacity peak detection -> (positions, values, count).
+
+    ``capacity`` defaults to n-2 (every interior point — never truncates).
+    Counts are clipped to capacity; excess peaks beyond it are dropped from
+    the left-compacted output.
+    """
+    impl = resolve_impl(impl)
+    data = np.asarray(data) if impl == "reference" else jnp.asarray(data)
+    n = data.shape[-1]
+    if n <= 2:
+        raise ValueError("size must be > 2 (detect_peaks.c:67)")
+    if capacity is None:
+        capacity = n - 2
+    if capacity < 1:
+        raise ValueError("capacity must be >= 1")
+    capacity = min(capacity, n - 2)  # interior points bound the peak count
+    if impl == "reference":
+        if data.ndim != 1:
+            raise ValueError("reference impl is 1-D (the C API shape)")
+        pos, val = _ref.detect_peaks(data, extremum_type)
+        count = min(len(pos), capacity)
+        positions = np.full(capacity, -1, np.int32)
+        values = np.zeros(capacity, np.float32)
+        positions[:count] = pos[:count]
+        values[:count] = val[:count]
+        return positions, values, np.int32(count)
+    return _detect_peaks_fixed_xla(data, int(extremum_type), int(capacity))
+
+
+def detect_peaks(data, extremum_type=EXTREMUM_TYPE_BOTH, *, impl=None):
+    """API-parity form -> (positions, values) trimmed to the found count
+    (the reference's ExtremumPoint array, detect_peaks.c:58-127)."""
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        pos, val = _ref.detect_peaks(data, extremum_type)
+        return pos, val.astype(np.float32)
+    positions, values, count = detect_peaks_fixed(data, extremum_type,
+                                                  impl=impl)
+    if positions.ndim != 1:
+        raise ValueError(
+            "trimmed detect_peaks is 1-D; use detect_peaks_fixed for batches")
+    count = int(count)
+    return np.asarray(positions)[:count], np.asarray(values)[:count]

@@ -1,0 +1,106 @@
+"""Normalization & minmax (src/normalize.c reborn).
+
+``normalize2D`` maps a uint8 plane to float32 in [-1, 1]:
+dst = (src - min) / ((max - min) / 2) - 1, zero-filled when the plane is
+constant (normalize.c:44-47). The reference's two-pass structure
+(minmax2D then normalize2D_minmax, normalize.c:435-441) survives as the
+public API split; on TPU the pair fuses into one XLA reduction + map.
+
+The C API's stride arguments are layout plumbing XLA owns; slicing a view
+before the call expresses the same thing. Leading batch dimensions are
+accepted everywhere (the per-plane reduction runs over the trailing 2 axes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.ops._dispatch import dispatch
+from veles.simd_tpu.reference import normalize as _ref
+
+
+@jax.jit
+def _minmax2D_xla(src):
+    src = jnp.asarray(src, jnp.uint8)
+    return (jnp.min(src, axis=(-2, -1)), jnp.max(src, axis=(-2, -1)))
+
+
+@jax.jit
+def _minmax1D_xla(src):
+    src = jnp.asarray(src)
+    return jnp.min(src, axis=-1), jnp.max(src, axis=-1)
+
+
+@jax.jit
+def _normalize2D_minmax_xla(vmin, vmax, src):
+    src = jnp.asarray(src, jnp.float32)
+    vmin = jnp.asarray(vmin, jnp.float32)
+    vmax = jnp.asarray(vmax, jnp.float32)
+    diff = (vmax - vmin) * jnp.float32(0.5)
+    # min == max -> zero fill (normalize.c:44-47); jnp.where keeps it jittable
+    safe = jnp.where(diff > 0, diff, jnp.float32(1))
+    out = (src - vmin[..., None, None]) / safe[..., None, None] - 1
+    return jnp.where((diff > 0)[..., None, None], out,
+                     jnp.zeros_like(out)).astype(jnp.float32)
+
+
+@jax.jit
+def _normalize2D_xla(src):
+    vmin, vmax = _minmax2D_xla(src)
+    return _normalize2D_minmax_xla(vmin, vmax, src)
+
+
+@jax.jit
+def _normalize1D_xla(src):
+    src = jnp.asarray(src, jnp.float32)
+    vmin = jnp.min(src, axis=-1, keepdims=True)
+    vmax = jnp.max(src, axis=-1, keepdims=True)
+    diff = (vmax - vmin) * jnp.float32(0.5)
+    safe = jnp.where(diff > 0, diff, jnp.float32(1))
+    out = (src - vmin) / safe - 1
+    return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
+
+
+def normalize1D(src, *, impl=None):
+    """Float signal -> [-1, 1] over the last axis; constant signals
+    zero-fill, matching normalize2D's policy (normalize.c:44-47).
+
+    Framework extension: the reference pairs minmax1D with caller-side
+    scaling (normalize.h:84-90); this is that pairing as one op, batch-aware
+    over leading axes.
+    """
+    return dispatch(impl, _ref.normalize1D, _normalize1D_xla)(src)
+
+
+def minmax2D(src, *, impl=None):
+    """(min, max) over a uint8 plane (normalize.c:443-464)."""
+    return dispatch(impl, _ref.minmax2D, _minmax2D_xla)(src)
+
+
+def minmax1D(src, *, impl=None):
+    """(min, max) over a float signal (normalize.c:318-367)."""
+    return dispatch(impl, _ref.minmax1D, _minmax1D_xla)(src)
+
+
+def normalize2D_minmax(vmin, vmax, src, *, impl=None):
+    """Affine map to [-1, 1] given precomputed (min, max)
+    (normalize.c:466-491)."""
+    from veles.simd_tpu.config import resolve_impl
+    if resolve_impl(impl) == "reference":
+        return _ref.normalize2D_minmax(vmin, vmax, src)
+    import numpy as np
+    if not (isinstance(vmin, jax.core.Tracer)
+            or isinstance(vmax, jax.core.Tracer)):
+        # host-side contract check only when concrete — under jit the pair
+        # comes from minmax2D and the invariant holds by construction
+        if np.any(np.asarray(vmin) > np.asarray(vmax)):
+            raise ValueError("min > max (normalize.c:483 assert)")
+    return _normalize2D_minmax_xla(vmin, vmax, src)
+
+
+def normalize2D(src, *, impl=None):
+    """uint8 plane -> float32 [-1, 1] (normalize.c:435-441)."""
+    return dispatch(impl, _ref.normalize2D, _normalize2D_xla)(src)

@@ -35,3 +35,15 @@ def normalize2D_minmax(vmin, vmax, src):
 def normalize2D(src):
     vmin, vmax = minmax2D(src)
     return normalize2D_minmax(vmin, vmax, src)
+
+
+def normalize1D(src):
+    """Framework extension: minmax1D + the normalize2D affine map over the
+    last axis (constant signals zero-fill)."""
+    src = np.asarray(src, dtype=np.float64)
+    vmin = src.min(axis=-1, keepdims=True)
+    vmax = src.max(axis=-1, keepdims=True)
+    diff = (vmax - vmin) / 2.0
+    out = np.zeros_like(src)
+    np.divide(src - vmin, diff, out=out, where=diff > 0)
+    return np.where(diff > 0, out - 1.0, 0.0)
