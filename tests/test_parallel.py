@@ -1,0 +1,141 @@
+"""Parallel layer tests on the virtual 8-device CPU mesh.
+
+Differential pattern: sharded op vs its single-device twin (the sharded
+path is "the other backend", SURVEY §4 port implication).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu import ops, parallel
+from veles.simd_tpu.reference import wavelet as ref_wavelet
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.default_mesh("seq")
+
+
+class TestMesh:
+    def test_make_mesh(self):
+        m = parallel.make_mesh({"data": 2, "seq": 4})
+        assert m.shape == {"data": 2, "seq": 4}
+
+    def test_wildcard_axis(self):
+        m = parallel.make_mesh({"seq": -1})
+        assert m.shape["seq"] == len(jax.devices())
+
+    def test_too_many_devices_rejected(self):
+        with pytest.raises(ValueError):
+            parallel.make_mesh({"seq": 1024})
+
+
+class TestConvolveSharded:
+    @pytest.mark.parametrize("n,m", [(1024, 33), (4096, 127), (512, 8)])
+    def test_zero_boundary_is_truncated_linear(self, rng, mesh, n, m):
+        x = rng.normal(size=n).astype(np.float32)
+        h = rng.normal(size=m).astype(np.float32)
+        want = np.asarray(ops.convolve(x, h, algorithm="fft"))[:n]
+        got = np.asarray(parallel.convolve_sharded(x, h, mesh))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_periodic_boundary_is_circular(self, rng, mesh):
+        n, m = 512, 31
+        x = rng.normal(size=n).astype(np.float32)
+        h = rng.normal(size=m).astype(np.float32)
+        want = np.real(np.fft.ifft(np.fft.fft(x, n) * np.fft.fft(h, n)))
+        got = np.asarray(parallel.convolve_sharded(x, h, mesh,
+                                                   boundary="periodic"))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+class TestWaveletSharded:
+    @pytest.mark.parametrize("ext", ["periodic", "zero"])
+    @pytest.mark.parametrize("order", [4, 8])
+    def test_dwt(self, rng, mesh, ext, order):
+        x = rng.normal(size=512).astype(np.float32)
+        want_hi, want_lo = ops.wavelet_apply(x, "daubechies", order, ext,
+                                             impl="xla")
+        hi, lo = parallel.wavelet_apply_sharded(x, "daubechies", order, ext,
+                                                mesh=mesh)
+        np.testing.assert_allclose(np.asarray(hi), np.asarray(want_hi),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(want_lo),
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("level", [1, 2, 3])
+    def test_swt(self, rng, mesh, level):
+        x = rng.normal(size=1024).astype(np.float32)
+        want_hi, want_lo = ops.stationary_wavelet_apply(
+            x, "daubechies", 8, level, "periodic", impl="xla")
+        hi, lo = parallel.stationary_wavelet_apply_sharded(
+            x, "daubechies", 8, level, "periodic", mesh=mesh)
+        np.testing.assert_allclose(np.asarray(hi), np.asarray(want_hi),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(want_lo),
+                                   atol=1e-4)
+
+    def test_odd_shard_rejected(self, mesh):
+        # 520/8 = 65 per shard: stride-2 windows would start at odd
+        # global offsets on half the devices
+        with pytest.raises(ValueError):
+            parallel.wavelet_apply_sharded(np.zeros(520, np.float32),
+                                           "daubechies", 4, "periodic",
+                                           mesh=mesh)
+
+    def test_mirror_rejected(self, mesh):
+        with pytest.raises(ValueError):
+            parallel.wavelet_apply_sharded(np.zeros(512, np.float32),
+                                           "daubechies", 8, "mirror",
+                                           mesh=mesh)
+
+
+class TestBatchMap:
+    def test_batched_normalize(self, rng):
+        mesh = parallel.default_mesh("data")
+        batch = rng.integers(0, 256, size=(8, 16, 32)).astype(np.uint8)
+        from veles.simd_tpu.ops.normalize import _normalize2D_xla
+        fn = parallel.batch_map(_normalize2D_xla, mesh)
+        out = np.asarray(fn(batch))
+        want = np.asarray(ops.normalize2D(batch, impl="xla"))
+        np.testing.assert_allclose(out, want, atol=1e-6)
+
+    def test_batched_peaks_pipeline(self, rng):
+        """The BASELINE batched config shape: per-signal normalize -> peaks
+        over a sharded batch (256 signals / 8 devices)."""
+        mesh = parallel.default_mesh("data")
+        batch = rng.normal(size=(256, 130)).astype(np.float32)
+
+        def per_signal(x):
+            from veles.simd_tpu.ops.detect_peaks import _detect_peaks_fixed_xla
+            from veles.simd_tpu.ops.normalize import _normalize1D_xla
+            return _detect_peaks_fixed_xla(_normalize1D_xla(x), 3, 128)
+
+        fn = parallel.batch_map(per_signal, mesh)
+        pos, val, count = fn(batch)
+        assert pos.shape == (256, 128)
+        assert count.shape == (256,)
+        # spot-check one signal against the one-device op
+        p0, v0, c0 = ops.detect_peaks_fixed(
+            ops.normalize1D(batch[0], impl="xla"), capacity=128, impl="xla")
+        assert int(count[0]) == int(c0)
+        np.testing.assert_array_equal(np.asarray(pos[0]), np.asarray(p0))
+
+
+class TestHaloContracts:
+    def test_indivisible_length_rejected(self, mesh):
+        fn = parallel.halo_map(lambda x: x, mesh, left=1)
+        with pytest.raises(ValueError):
+            fn(np.zeros(1001, np.float32))
+
+    def test_oversized_halo_rejected(self, mesh):
+        fn = parallel.halo_map(lambda x: x, mesh, left=1024)
+        with pytest.raises(ValueError):
+            fn(np.zeros(2048, np.float32))  # shard = 256 < 1024
+
+    def test_bad_boundary_rejected(self, mesh):
+        with pytest.raises(ValueError):
+            parallel.halo_map(lambda x: x, mesh, boundary="mirror")

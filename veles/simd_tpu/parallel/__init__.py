@@ -1,0 +1,24 @@
+"""Device-mesh parallelism layer.
+
+The reference has no distributed runtime — its parallelism is SIMD lanes
+plus the overlap-save block decomposition of long signals (SURVEY §2
+parallelism inventory). This package maps those axes onto the TPU fabric:
+
+* ``mesh``     — mesh construction helpers (ICI within a slice, DCN across
+  hosts; one ``jax.sharding.Mesh`` either way).
+* ``halo``     — ``halo_map``, the sequence-parallel primitive: shard a long
+  signal over a mesh axis, exchange boundary samples over ICI with
+  ``jax.lax.ppermute``, apply a local windowed op. This is overlap-save
+  (convolve.c:178-228) promoted from "blocks within one core" to "shards
+  across the mesh" — the framework's context-parallelism story.
+* ``ops``      — sharded signal ops built on halo_map: convolution,
+  decimated and stationary wavelets; plus ``batch_map`` for data-parallel
+  batching of any single-signal op.
+"""
+
+from veles.simd_tpu.parallel.mesh import (  # noqa: F401
+    default_mesh, make_mesh)
+from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
+from veles.simd_tpu.parallel.ops import (  # noqa: F401
+    batch_map, convolve_sharded, stationary_wavelet_apply_sharded,
+    wavelet_apply_sharded)

@@ -1,0 +1,106 @@
+"""``halo_map`` — the sequence-parallel halo-exchange combinator.
+
+The reference processes long signals as overlapping FFT blocks inside one
+core, carrying M-1 boundary samples between consecutive blocks
+(convolve.c:178-228). ``halo_map`` lifts that exact pattern onto a device
+mesh: the signal lives sharded along a mesh axis, each device exchanges its
+boundary samples with its neighbors over ICI (``jax.lax.ppermute``), and a
+local windowed op maps the halo-extended block to the local output block.
+Windowed ops (convolution, wavelet filter banks) become embarrassingly
+parallel with only O(window) communication — the framework's context
+parallelism (SURVEY §5 long-context plan).
+
+Boundary policy at the global signal ends:
+  * ``"zero"``     — the halos wrapping past the ends are zeroed (linear
+    convolution semantics; EXTENSION_ZERO).
+  * ``"periodic"`` — the circular ppermute wrap-around IS the periodic
+    extension (circular convolution semantics; EXTENSION_PERIODIC) — no
+    masking, no extra traffic.
+Mirror/constant extensions need values from the far ends and are
+deliberately not offered sharded; gather first if you need them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+BOUNDARIES = ("zero", "periodic")
+
+
+def halo_map(fn, mesh, axis="seq", *, left=0, right=0, boundary="zero",
+             n_broadcast_args=0, batch_axis=None):
+    """Wrap a local windowed op into a sharded signal op.
+
+    ``fn(x_ext, *broadcast_args)`` sees its local shard extended by ``left``
+    samples from the previous device and ``right`` from the next, and must
+    return the local output shard (any trailing length; shards concatenate
+    along the last axis). Returns a callable over the full (sharded or
+    replicated) signal; output is sharded along ``axis``.
+
+    ``n_broadcast_args`` extra arguments are replicated to every device
+    (filter taps, etc.). ``batch_axis`` controls a leading batch dimension:
+    ``None`` (default) — 1-D signals only; a mesh axis name — the batch dim
+    is sharded over that axis (dp x sp on one mesh); ``True`` — a batch dim
+    present but replicated. ``fn`` then sees a (local_batch, ext_length)
+    block.
+    """
+    if boundary not in BOUNDARIES:
+        raise ValueError(f"boundary must be one of {BOUNDARIES}")
+    n_shards = mesh.shape[axis]
+    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+
+    def local(x_local, *args):
+        parts = []
+        idx = jax.lax.axis_index(axis)
+        if left:
+            prev = jax.lax.ppermute(x_local[..., -left:], axis, fwd)
+            if boundary == "zero":
+                prev = jnp.where(idx == 0, jnp.zeros_like(prev), prev)
+            parts.append(prev)
+        parts.append(x_local)
+        if right:
+            nxt = jax.lax.ppermute(x_local[..., :right], axis, bwd)
+            if boundary == "zero":
+                nxt = jnp.where(idx == n_shards - 1, jnp.zeros_like(nxt),
+                                nxt)
+            parts.append(nxt)
+        x_ext = jnp.concatenate(parts, axis=-1) if len(parts) > 1 else x_local
+        return fn(x_ext, *args)
+
+    if batch_axis is None:
+        spec = P(axis)
+    else:
+        spec = P(None if batch_axis is True else batch_axis, axis)
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec,) + (P(),) * n_broadcast_args,
+        out_specs=spec)
+
+    expected_ndim = 1 if batch_axis is None else 2
+
+    @functools.wraps(fn)
+    def wrapped(x, *args):
+        x = jnp.asarray(x)
+        n = x.shape[-1]
+        if x.ndim != expected_ndim:
+            raise ValueError(
+                f"halo_map expects a {expected_ndim}-D input for "
+                f"batch_axis={batch_axis!r}, got shape {x.shape}; use "
+                "batch_map for un-sharded leading batch axes")
+        if n % n_shards != 0:
+            raise ValueError(
+                f"signal length {n} not divisible by {n_shards} shards")
+        shard = n // n_shards
+        if max(left, right) > shard:
+            raise ValueError(
+                f"halo ({max(left, right)}) exceeds shard length {shard}; "
+                "use fewer devices or longer signals")
+        return sharded(x, *args)
+
+    return wrapped

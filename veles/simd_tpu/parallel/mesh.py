@@ -1,0 +1,49 @@
+"""Mesh construction helpers.
+
+One ``jax.sharding.Mesh`` covers every scale: VPU lanes are XLA's problem,
+a v5e-8 slice rides ICI, multi-host rides DCN — the axis layout, not the
+transport, is what the framework controls. Axis convention:
+
+* ``"data"`` — batch data-parallelism (independent signals).
+* ``"seq"``  — sequence parallelism (one long signal sharded; halo.py).
+
+The reference has no analogue (zero MPI/NCCL/sockets — SURVEY §2); this is
+where its single-core overlap-save block loop becomes a device axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}`` (e.g. {"data": 2, "seq": 4}).
+
+    A size of -1 (at most one axis) absorbs the remaining devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes)
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devices) % known != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh needs {total} devices, only {len(devices)} available")
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def default_mesh(axis_name: str = "seq", devices=None) -> Mesh:
+    """All available devices on one named axis."""
+    return make_mesh({axis_name: -1}, devices)

@@ -1,0 +1,148 @@
+"""Sharded signal ops built on ``halo_map``, plus data-parallel batching.
+
+Each op mirrors its single-device twin in veles.simd_tpu.ops; differential
+tests compare the two on a virtual 8-device mesh (SURVEY §4 port
+implication — the sharded path is "the other backend" to test against).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from veles.simd_tpu import wavelet_data
+from veles.simd_tpu.ops.wavelet import (EXTENSION_PERIODIC, EXTENSION_ZERO,
+                                        _filter_bank_conv)
+from veles.simd_tpu.parallel.halo import halo_map
+
+_SHARDABLE_EXT = {EXTENSION_PERIODIC: "periodic", EXTENSION_ZERO: "zero"}
+
+
+def convolve_sharded(x, h, mesh, axis="seq", *, boundary="zero"):
+    """Sequence-parallel 1-D convolution over a device mesh.
+
+    Each device convolves its halo-extended shard locally (VALID windows
+    only — every output sample is computed exactly once); the halo is the
+    M-1 trailing samples of the previous shard, the distributed form of
+    overlap-save's inter-block overlap (convolve.c:178-228).
+
+    Returns length n (= len(x)) sharded along ``axis``:
+      * boundary="zero"     -> linear convolution truncated to n samples
+        (conv(x, h)[:n]; the m-1 tail lives past the last shard).
+      * boundary="periodic" -> circular convolution of length n.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    m = h.shape[-1]
+
+    def local(x_ext, h):
+        # x_ext = [m-1 halo | shard]; VALID correlation with flipped h
+        # yields exactly the shard's samples of the linear convolution.
+        lhs = x_ext.reshape(1, 1, -1)
+        rhs = h[::-1].reshape(1, 1, -1)
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,), padding="VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        return out.reshape(-1)
+
+    fn = halo_map(local, mesh, axis, left=m - 1, boundary=boundary,
+                  n_broadcast_args=1)
+    return fn(x, h)
+
+
+def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
+                          ext=EXTENSION_PERIODIC, *, mesh, axis="seq"):
+    """Sequence-parallel decimated DWT step -> (hi, lo), each length n/2
+    sharded along ``axis``.
+
+    The right-extension of the single-device op (order samples past the
+    shard end, src/wavelet.c:247-268) becomes the halo from the next
+    device; periodic/zero extensions only (mirror/constant need the far
+    ends — gather first).
+    """
+    boundary = _shardable(ext)
+    x = jnp.asarray(x, jnp.float32)
+    n_shards = mesh.shape[axis]
+    shard = x.shape[-1] // max(n_shards, 1)
+    if x.shape[-1] % n_shards != 0 or shard % 2 != 0:
+        raise ValueError(
+            f"signal length {x.shape[-1]} must split into even-length "
+            f"shards across {n_shards} devices (stride-2 windows must "
+            "start at even global offsets)")
+    hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order, np.float32)
+    filters = jnp.asarray(np.stack([hi, lo]))
+
+    def local(x_ext, filters):
+        half = (x_ext.shape[-1] - order) // 2
+        out = _filter_bank_conv(x_ext, filters, 2, 1, half)
+        return jnp.concatenate([out[..., 0, :], out[..., 1, :]], axis=-1)
+
+    fn = halo_map(local, mesh, axis, right=order, boundary=boundary,
+                  n_broadcast_args=1)
+    both = fn(x, filters)  # per-shard [hi | lo] concatenated along the axis
+    return _split_bands(both, mesh.shape[axis])
+
+
+def stationary_wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
+                                     level=1, ext=EXTENSION_PERIODIC, *,
+                                     mesh, axis="seq"):
+    """Sequence-parallel stationary WT step -> full-length (hi, lo) pair
+    sharded along ``axis``. Halo = the dilated filter span."""
+    boundary = _shardable(ext)
+    if level < 1:
+        raise ValueError("level must be >= 1")
+    stride = 1 << (level - 1)
+    x = jnp.asarray(x, jnp.float32)
+    hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order, np.float32)
+    filters = jnp.asarray(np.stack([hi, lo]))
+    span = order * stride
+
+    def local(x_ext, filters):
+        n_local = x_ext.shape[-1] - span
+        out = _filter_bank_conv(x_ext, filters, 1, stride, n_local)
+        return jnp.concatenate([out[..., 0, :], out[..., 1, :]], axis=-1)
+
+    fn = halo_map(local, mesh, axis, right=span, boundary=boundary,
+                  n_broadcast_args=1)
+    both = fn(x, filters)
+    return _split_bands(both, mesh.shape[axis])
+
+
+def _shardable(ext):
+    if ext not in _SHARDABLE_EXT:
+        raise ValueError(
+            f"extension {ext!r} is not shardable (periodic/zero only; "
+            "mirror/constant need the far signal ends)")
+    return _SHARDABLE_EXT[ext]
+
+
+def _split_bands(both, n_shards):
+    """Undo the per-shard [hi | lo] concatenation into two band arrays.
+
+    Each shard contributed [hi_k | lo_k]; globally the array interleaves
+    per-shard band pairs, so a reshape separates them without any
+    cross-device traffic at trace level (XLA sees a relayout).
+    """
+    n2 = both.shape[-1] // (2 * n_shards)
+    grouped = both.reshape(n_shards, 2, n2)
+    return grouped[:, 0, :].reshape(-1), grouped[:, 1, :].reshape(-1)
+
+
+def batch_map(fn, mesh, axis="data", *, n_broadcast_args=0):
+    """Data-parallel batching: shard the leading batch axis over ``axis``
+    and vmap ``fn`` over the local batch — the TPU form of the reference's
+    caller-side per-signal loop (it has no batch API; SURVEY §2)."""
+    vfn = jax.vmap(fn)
+
+    def local(batch, *args):
+        return vfn(batch, *args)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis),) + (P(),) * n_broadcast_args,
+        out_specs=P(axis))

@@ -1,0 +1,152 @@
+"""Secondary benchmark configs (BASELINE.json / BASELINE.md table).
+
+Run via ``python bench.py --all``; each config prints one JSON line to the
+given stream. The headline metric (matmul N=4096) stays in bench.py; these
+fill the remaining BASELINE table rows:
+
+  * float32 elementwise add/mul/scale, N = 1M (tests/arithmetic.cc shapes)
+  * 1-D convolve signal=65536 kernel=127, overlap-save path
+    (src/convolve.c:103-229 analogue)
+  * 1-D DWT db8, 6 levels, N = 262144 (src/wavelet.c:1042-1124 analogue)
+  * batched normalize + detect_peaks over 256 signals
+    (normalize.c:435-441 + detect_peaks.c:58-127 under vmap)
+
+Timing method matches bench.py: iterations chained inside one jitted
+lax.scan with a data dependency, ending in a scalar checksum fetch (the
+axon tunnel defers execution, so per-dispatch wall-clocking is dishonest).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _chain_time(step_fn, carry, iters):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(c):
+        def body(c, _):
+            return step_fn(c), None
+        c, _ = jax.lax.scan(body, c, None, length=iters)
+        leaves = jax.tree_util.tree_leaves(c)
+        return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
+
+    float(chain(carry))  # compile + warm
+    t0 = time.perf_counter()
+    checksum = float(chain(carry))
+    dt = (time.perf_counter() - t0) / iters
+    assert checksum == checksum, "NaN checksum"
+    return dt
+
+
+def bench_elementwise(scale=1):
+    import jax
+    import jax.numpy as jnp
+
+    n = int(1e6 * scale)
+    x = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+
+    def step(c):
+        # add / mul / scale fused round-trip (tests/arithmetic.cc kernels)
+        return (c + c) * c * jnp.float32(0.5)
+
+    dt = _chain_time(step, x, 32)
+    return {"metric": f"elementwise_add_mul_scale_n{n}",
+            "value": round(n * 3 / dt / 1e9, 2), "unit": "Gop/s",
+            "vs_baseline": None}
+
+
+def bench_convolve(scale=1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu.ops.convolve import _convolve_overlap_save_xla
+    from veles.simd_tpu.shapes import overlap_save_fft_length
+
+    n, m = int(65536 * scale), 127
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=m).astype(np.float32) / m)
+    L = overlap_save_fft_length(m)
+
+    def step(c):
+        out = _convolve_overlap_save_xla(c, h, L=L, out_length=n + m - 1)
+        return out[:n]  # keep the carry shape fixed
+
+    dt = _chain_time(step, x, 16)
+    return {"metric": f"convolve_os_n{n}_m{m}",
+            "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None}
+
+
+def bench_dwt(scale=1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu import wavelet_data
+    from veles.simd_tpu.ops.wavelet import _wavelet_apply_xla
+
+    n, levels = int(262144 * scale), 6
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hi, lo = wavelet_data.highpass_lowpass("daubechies", 8, np.float32)
+    filters = jnp.asarray(np.stack([hi, lo]))
+
+    @jax.jit
+    def six_level(c):
+        lo_band = c
+        acc = jnp.float32(0)
+        for _ in range(levels):
+            hi_b, lo_band = _wavelet_apply_xla(lo_band, filters, "periodic")
+            acc = acc + jnp.sum(hi_b)
+        # fold the cascade back into a fixed-shape carry
+        return c + jnp.pad(lo_band * 0, (0, n - lo_band.shape[-1])) + acc / n
+
+    dt = _chain_time(six_level, x, 16)
+    # samples processed across the cascade: n + n/2 + ... ~ 2n input samples
+    return {"metric": f"dwt_db8_6level_n{n}",
+            "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None}
+
+
+def bench_batched_pipeline(scale=1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu.ops.detect_peaks import _detect_peaks_fixed_xla
+    from veles.simd_tpu.ops.normalize import _normalize1D_xla
+
+    batch, n = 256, int(4096 * scale)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+
+    def step(c):
+        norm = _normalize1D_xla(c)
+        _, vals, _ = _detect_peaks_fixed_xla(norm, 3, 64)
+        return norm + jnp.float32(1e-6) * jnp.sum(vals) / n
+
+    dt = _chain_time(step, x, 16)
+    return {"metric": f"normalize_peaks_b{batch}_n{n}",
+            "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None}
+
+
+CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
+           bench_batched_pipeline)
+
+
+def run_secondary(stream, scale=None):
+    import jax
+    if scale is None:
+        scale = 1 if jax.default_backend() == "tpu" else 1 / 64
+    for cfg in CONFIGS:
+        try:
+            print(json.dumps(cfg(scale)), file=stream, flush=True)
+        except Exception as e:  # keep the headline metric alive regardless
+            print(json.dumps({"metric": cfg.__name__, "error": str(e)}),
+                  file=stream, flush=True)
