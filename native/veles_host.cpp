@@ -1,0 +1,289 @@
+// veles_host — native host-side runtime for the TPU framework.
+//
+// TPU-native counterpart of the reference's memory layer
+// (/root/reference/src/memory.c:41-175, inc/simd/memory.h:51-161) and the
+// host-resident half of its conversion kernels
+// (inc/simd/arithmetic-inl.h:43-85).  On TPU the device side of those ops
+// belongs to XLA; what remains genuinely native is the *staging path*:
+// page/cacheline-aligned pooled buffers that host threads fill (set /
+// reverse / widen / zero-pad) before a zero-copy hand-off to the device
+// transfer engine.  Plain restrict-qualified loops at -O3 -march=native:
+// the compiler emits the AVX the reference hand-wrote.
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+#if defined(_WIN32)
+#error "POSIX host runtime only"
+#endif
+
+#define VH_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+constexpr size_t kDefaultAlignment = 64;  // cacheline; >= any vector width
+
+inline bool is_pow2(size_t x) { return x && !(x & (x - 1)); }
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Aligned allocation (reference: malloc_aligned / malloc_aligned_offset /
+// mallocf, memory.c:63-83).
+// ---------------------------------------------------------------------------
+
+VH_API void* vh_alloc_aligned(size_t size, size_t alignment) {
+  if (alignment == 0) alignment = kDefaultAlignment;
+  if (!is_pow2(alignment) || alignment < sizeof(void*)) return nullptr;
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, alignment, size ? size : alignment) != 0)
+    return nullptr;
+  return ptr;
+}
+
+VH_API void vh_free(void* ptr) { free(ptr); }
+
+// Distance (in elements of elem_size) from ptr to the next alignment
+// boundary (reference: align_complement_f32/i16/i32, memory.c:41-61).
+VH_API int64_t vh_align_complement(const void* ptr, size_t alignment,
+                                   size_t elem_size) {
+  if (!is_pow2(alignment) || elem_size == 0) return -1;
+  uintptr_t addr = reinterpret_cast<uintptr_t>(ptr);
+  uintptr_t rem = addr & (alignment - 1);
+  if (rem == 0) return 0;
+  return static_cast<int64_t>((alignment - rem) / elem_size);
+}
+
+// ---------------------------------------------------------------------------
+// Vectorized host fills / copies (reference: memsetf memory.c:85-115,
+// rmemcpyf :136-166, crmemcpyf :168-175).
+// ---------------------------------------------------------------------------
+
+VH_API void vh_fill_f32(float* __restrict dst, float value, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = value;
+}
+
+// Reversed copy: dst[i] = src[n-1-i].
+VH_API void vh_reverse_f32(float* __restrict dst, const float* __restrict src,
+                           size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = src[n - 1 - i];
+}
+
+// Complex-pairwise reversed copy over n floats (n even): the order of
+// (re,im) pairs reverses, each pair stays intact.
+VH_API void vh_reverse_c64(float* __restrict dst, const float* __restrict src,
+                           size_t n) {
+  for (size_t i = 0; i + 1 < n; i += 2) {
+    dst[i] = src[n - i - 2];
+    dst[i + 1] = src[n - i - 1];
+  }
+}
+
+// Copy n then zero-fill to padded_n (>= n).  The padded length policy
+// (2 x next-pow2, memory.c:121-134) lives in Python (shapes.py) so there is
+// one source of truth; this is the data movement half.
+VH_API void vh_zeropad_f32(float* __restrict dst, const float* __restrict src,
+                           size_t n, size_t padded_n) {
+  memcpy(dst, src, n * sizeof(float));
+  if (padded_n > n) memset(dst + n, 0, (padded_n - n) * sizeof(float));
+}
+
+// ---------------------------------------------------------------------------
+// Host-side widening/narrowing conversions for the staging path
+// (reference: arithmetic-inl.h:43-85 scalar spec; device twins live in
+// veles/simd_tpu/ops/arithmetic.py).  Saturating narrows, like the
+// reference's packs_epi32-based kernels.
+// ---------------------------------------------------------------------------
+
+VH_API void vh_i16_to_f32(float* __restrict dst, const int16_t* __restrict src,
+                          size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = static_cast<float>(src[i]);
+}
+
+VH_API void vh_i32_to_f32(float* __restrict dst, const int32_t* __restrict src,
+                          size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = static_cast<float>(src[i]);
+}
+
+VH_API void vh_f32_to_i16(int16_t* __restrict dst, const float* __restrict src,
+                          size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    float v = src[i];
+    if (!(v == v)) {  // NaN -> 0; cast of NaN is UB
+      dst[i] = 0;
+    } else if (v >= 32767.f) {
+      dst[i] = 32767;
+    } else if (v <= -32768.f) {
+      dst[i] = -32768;
+    } else {
+      dst[i] = static_cast<int16_t>(v);
+    }
+  }
+}
+
+VH_API void vh_i32_to_i16(int16_t* __restrict dst,
+                          const int32_t* __restrict src, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    int32_t v = src[i];
+    if (v > 32767) v = 32767;
+    if (v < -32768) v = -32768;
+    dst[i] = static_cast<int16_t>(v);
+  }
+}
+
+VH_API void vh_i16_to_i32(int32_t* __restrict dst,
+                          const int16_t* __restrict src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = static_cast<int32_t>(src[i]);
+}
+
+VH_API void vh_f32_to_i32(int32_t* __restrict dst,
+                          const float* __restrict src, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    float v = src[i];
+    if (!(v == v)) {  // NaN -> 0; cast of NaN is UB
+      dst[i] = 0;
+    } else if (v >= 2147483648.f) {  // 2^31 is the smallest unrepresentable
+      dst[i] = INT32_MAX;
+    } else if (v <= -2147483648.f) {
+      dst[i] = INT32_MIN;
+    } else {
+      dst[i] = static_cast<int32_t>(v);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Staging buffer pool — the piece the reference never needed (single
+// process, no device) but a TPU host runtime does: reusable aligned
+// buffers so per-batch host prep does not churn the allocator, and a
+// generation counter so double-release is caught in tests.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Slot {
+  void* ptr = nullptr;
+  bool in_use = false;
+};
+
+struct Pool {
+  size_t buffer_size = 0;
+  size_t alignment = kDefaultAlignment;
+  std::vector<Slot> slots;
+  std::mutex mu;
+  bool destroyed = false;
+  std::atomic<uint64_t> acquires{0};
+  std::atomic<uint64_t> grows{0};
+};
+
+std::mutex g_pools_mu;
+std::vector<Pool*> g_pools;
+
+Pool* pool_from_handle(int64_t handle) {
+  std::lock_guard<std::mutex> lock(g_pools_mu);
+  if (handle < 0 || handle >= static_cast<int64_t>(g_pools.size()))
+    return nullptr;
+  return g_pools[static_cast<size_t>(handle)];
+}
+
+}  // namespace
+
+VH_API int64_t vh_pool_create(size_t buffer_size, size_t count,
+                              size_t alignment) {
+  if (alignment == 0) alignment = kDefaultAlignment;
+  auto* pool = new (std::nothrow) Pool;
+  if (!pool) return -1;
+  pool->buffer_size = buffer_size;
+  pool->alignment = alignment;
+  pool->slots.resize(count);
+  for (auto& slot : pool->slots) {
+    slot.ptr = vh_alloc_aligned(buffer_size, alignment);
+    if (!slot.ptr) {
+      for (auto& s : pool->slots)
+        if (s.ptr) free(s.ptr);
+      delete pool;
+      return -1;
+    }
+  }
+  std::lock_guard<std::mutex> lock(g_pools_mu);
+  g_pools.push_back(pool);
+  return static_cast<int64_t>(g_pools.size()) - 1;
+}
+
+// Returns a buffer, growing the pool if every slot is busy (index via
+// *slot_out; pointer as return).  Thread-safe: loader threads acquire
+// concurrently while the transfer thread releases.
+VH_API void* vh_pool_acquire(int64_t handle, int64_t* slot_out) {
+  Pool* pool = pool_from_handle(handle);
+  if (!pool) return nullptr;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  if (pool->destroyed) return nullptr;
+  pool->acquires.fetch_add(1, std::memory_order_relaxed);
+  for (size_t i = 0; i < pool->slots.size(); ++i) {
+    if (!pool->slots[i].in_use) {
+      pool->slots[i].in_use = true;
+      if (slot_out) *slot_out = static_cast<int64_t>(i);
+      return pool->slots[i].ptr;
+    }
+  }
+  Slot slot;
+  slot.ptr = vh_alloc_aligned(pool->buffer_size, pool->alignment);
+  if (!slot.ptr) return nullptr;
+  slot.in_use = true;
+  pool->slots.push_back(slot);
+  pool->grows.fetch_add(1, std::memory_order_relaxed);
+  if (slot_out) *slot_out = static_cast<int64_t>(pool->slots.size()) - 1;
+  return slot.ptr;
+}
+
+// 0 on success, -1 on bad handle/slot, -2 on double release.
+VH_API int vh_pool_release(int64_t handle, int64_t slot) {
+  Pool* pool = pool_from_handle(handle);
+  if (!pool) return -1;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  if (pool->destroyed) return -1;
+  if (slot < 0 || slot >= static_cast<int64_t>(pool->slots.size())) return -1;
+  if (!pool->slots[static_cast<size_t>(slot)].in_use) return -2;
+  pool->slots[static_cast<size_t>(slot)].in_use = false;
+  return 0;
+}
+
+VH_API int64_t vh_pool_size(int64_t handle) {
+  Pool* pool = pool_from_handle(handle);
+  if (!pool) return -1;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  if (pool->destroyed) return -1;
+  return static_cast<int64_t>(pool->slots.size());
+}
+
+VH_API int64_t vh_pool_grows(int64_t handle) {
+  Pool* pool = pool_from_handle(handle);
+  if (!pool) return -1;
+  return static_cast<int64_t>(pool->grows.load(std::memory_order_relaxed));
+}
+
+// 0 on success; -1 bad handle; -2 refused, leases still outstanding (their
+// buffers back live caller views — freeing them would dangle).  The Pool
+// struct itself is never deleted: stale handles then race only against a
+// `destroyed` flag read under the pool mutex, not a freed mutex.
+VH_API int vh_pool_destroy(int64_t handle) {
+  Pool* pool = pool_from_handle(handle);
+  if (!pool) return -1;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  if (pool->destroyed) return -1;
+  for (const auto& slot : pool->slots)
+    if (slot.in_use) return -2;
+  for (auto& slot : pool->slots)
+    if (slot.ptr) free(slot.ptr);
+  pool->slots.clear();
+  pool->slots.shrink_to_fit();
+  pool->destroyed = true;
+  return 0;
+}
+
+VH_API int vh_abi_version() { return 1; }

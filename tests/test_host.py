@@ -1,0 +1,237 @@
+"""Host runtime tests — native C++ staging layer vs NumPy fallback.
+
+Mirrors the reference's memory tests (tests/memory_test.cc:29-75: alignment
+properties, reversed-copy correctness) with the differential twist of
+SURVEY §4: the NumPy fallback is the `_na` oracle for the native library.
+"""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import host, shapes
+from veles.simd_tpu.host import _native
+
+NATIVE = host.native_available()
+
+
+def rng():
+    return np.random.default_rng(1234)
+
+
+# ---------------------------------------------------------------------------
+# alignment / allocation properties (memory_test.cc:29-75 analogue)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("alignment", [64, 128, 4096])
+def test_aligned_empty_alignment(alignment):
+    for shape in [7, (3, 5), (1,), 1024]:
+        a = host.aligned_empty(shape, np.float32, alignment=alignment)
+        assert a.ctypes.data % alignment == 0
+        a[...] = 1.0  # writable
+        assert host.align_complement(a, alignment) == 0
+
+
+def test_aligned_empty_offset():
+    a = host.aligned_empty(16, np.float32, alignment=64, offset=4)
+    assert a.ctypes.data % 64 == 4
+    comp = host.align_complement(a, 64)
+    assert comp == (64 - 4) // 4
+
+
+def test_align_complement_dtypes():
+    # reference exposes f32/i16/i32 probes (memory.c:41-61); ours is generic
+    for dtype in (np.float32, np.int16, np.int32):
+        a = host.aligned_empty(64, dtype, alignment=64)
+        assert host.align_complement(a, 32) == 0
+
+
+def test_aligned_buffer_survives_view_chain():
+    a = host.aligned_empty(256, np.float32)
+    a[:] = np.arange(256, dtype=np.float32)
+    v = a[5:100:2]
+    del a
+    assert v[0] == 5.0 and v[-1] == 99.0
+
+
+# ---------------------------------------------------------------------------
+# fills / reversed copies / zero padding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 3, 8, 17, 1024, 4099])
+def test_memsetf(n):
+    a = host.aligned_empty(n, np.float32)
+    host.memsetf(a, 2.5)
+    np.testing.assert_array_equal(a, np.full(n, 2.5, np.float32))
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 8, 9, 63, 64, 65, 1000])
+def test_rmemcpyf(n):
+    src = rng().normal(size=n).astype(np.float32)
+    dst = host.aligned_empty(n, np.float32)
+    out = host.rmemcpyf(dst, src)
+    assert out is dst
+    np.testing.assert_array_equal(dst, src[::-1])
+
+
+@pytest.mark.parametrize("n", [2, 4, 10, 64, 1000])
+def test_crmemcpyf(n):
+    src = rng().normal(size=n).astype(np.float32)
+    dst = host.aligned_empty(n, np.float32)
+    host.crmemcpyf(dst, src)
+    expect = src.reshape(-1, 2)[::-1].reshape(-1)
+    np.testing.assert_array_equal(dst, expect)
+
+
+def test_crmemcpyf_odd_rejected():
+    a = host.aligned_empty(3, np.float32)
+    with pytest.raises(ValueError):
+        host.crmemcpyf(a, a.copy())
+
+
+@pytest.mark.parametrize("n", [1, 5, 64, 100, 1023])
+def test_zeropadding_policy(n):
+    src = rng().normal(size=n).astype(np.float32)
+    out = host.zeropadding(src)
+    assert out.size == shapes.zeropadding_length(n)
+    np.testing.assert_array_equal(out[:n], src)
+    np.testing.assert_array_equal(out[n:], 0.0)
+
+
+def test_zeropaddingex_additional():
+    src = np.ones(10, np.float32)
+    out = host.zeropaddingex(src, 7)
+    assert out.size == shapes.zeropadding_length(10) + 7
+    np.testing.assert_array_equal(out[10:], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# conversions (saturating narrows per arithmetic-inl.h:43-85)
+# ---------------------------------------------------------------------------
+
+def test_convert_roundtrip_i16():
+    src = rng().integers(-32768, 32767, 1000).astype(np.int16)
+    f = host.convert(src, np.float32)
+    assert f.dtype == np.float32
+    back = host.convert(f, np.int16)
+    np.testing.assert_array_equal(back, src)
+
+
+def test_convert_saturates():
+    src = np.array([1e6, -1e6, 40000.0, -40000.0, 0.5], np.float32)
+    out = host.convert(src, np.int16)
+    np.testing.assert_array_equal(out[:4], [32767, -32768, 32767, -32768])
+
+
+def test_convert_f32_i32_saturates_and_nan():
+    src = np.array([5e9, -5e9, np.nan, 123.7], np.float32)
+    out = host.convert(src, np.int32)
+    np.testing.assert_array_equal(
+        out, [2147483647, -2147483648, 0, 123])
+    out16 = host.convert(np.array([np.nan, 1.0], np.float32), np.int16)
+    np.testing.assert_array_equal(out16, [0, 1])
+
+
+def test_convert_i32_paths():
+    src = np.array([1 << 20, -(1 << 20), 123], np.int32)
+    as_f = host.convert(src, np.float32)
+    np.testing.assert_array_equal(as_f, src.astype(np.float32))
+    as_i16 = host.convert(src, np.int16)
+    np.testing.assert_array_equal(as_i16, [32767, -32768, 123])
+    widened = host.convert(np.array([-5, 6], np.int16), np.int32)
+    assert widened.dtype == np.int32
+    np.testing.assert_array_equal(widened, [-5, 6])
+
+
+# ---------------------------------------------------------------------------
+# differential: native vs NumPy-fallback semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not NATIVE, reason="native host runtime not built")
+def test_native_matches_fallback(monkeypatch):
+    src = rng().normal(size=777).astype(np.float32)
+    native_rev = host.rmemcpyf(host.aligned_empty(777, np.float32), src)
+    native_pad = host.zeropadding(src)
+
+    monkeypatch.setattr(_native, "load", lambda: None)
+    fb_rev = host.rmemcpyf(np.empty(777, np.float32), src)
+    fb_pad = host.zeropadding(src)
+    np.testing.assert_array_equal(native_rev, fb_rev)
+    np.testing.assert_array_equal(native_pad, fb_pad)
+
+
+# ---------------------------------------------------------------------------
+# staging pool
+# ---------------------------------------------------------------------------
+
+def test_pool_acquire_release_reuse():
+    with host.StagingPool(nbytes=1 << 16, count=2) as pool:
+        slot, a = pool.acquire((64, 64), np.float32)
+        a[:] = 1.0
+        pool.release(slot)
+        slot2, b = pool.acquire(4096, np.float32)
+        pool.release(slot2)
+        assert pool.size == 2 and pool.grow_count == 0
+
+
+def test_pool_grows_under_contention():
+    with host.StagingPool(nbytes=1024, count=1) as pool:
+        leases = [pool.acquire(256, np.float32) for _ in range(3)]
+        assert pool.size == 3 and pool.grow_count == 2
+        for slot, _ in leases:
+            pool.release(slot)
+
+
+def test_pool_double_release_detected():
+    with host.StagingPool(nbytes=1024, count=1) as pool:
+        slot, _ = pool.acquire(16, np.float32)
+        pool.release(slot)
+        with pytest.raises(RuntimeError):
+            pool.release(slot)
+
+
+def test_pool_close_refuses_outstanding_lease():
+    pool = host.StagingPool(nbytes=1024, count=1)
+    slot, _ = pool.acquire(16, np.float32)
+    with pytest.raises(RuntimeError):
+        pool.close()
+    pool.release(slot)
+    pool.close()
+
+
+def test_zeropaddingex_rejects_negative():
+    with pytest.raises(ValueError):
+        host.zeropaddingex(np.ones(8, np.float32), -1)
+
+
+def test_pool_oversized_request_rejected():
+    with host.StagingPool(nbytes=1024, count=1) as pool:
+        with pytest.raises(ValueError):
+            pool.acquire(1025, np.uint8)
+
+
+def test_pool_buffer_context_and_to_device():
+    import jax.numpy as jnp
+
+    with host.StagingPool(nbytes=1 << 12, count=1) as pool:
+        with pool.buffer((8, 16), np.float32) as buf:
+            buf[:] = np.arange(128, dtype=np.float32).reshape(8, 16)
+            assert buf.ctypes.data % 64 == 0
+            dev = host.to_device(buf)
+        np.testing.assert_array_equal(
+            np.asarray(dev),
+            np.arange(128, dtype=np.float32).reshape(8, 16))
+        assert isinstance(dev, jnp.ndarray)
+
+
+@pytest.mark.skipif(not NATIVE, reason="native runtime not built")
+def test_native_abi():
+    lib = _native.load()
+    assert lib.vh_abi_version() == _native.ABI_VERSION
+    # stale pool handles fail cleanly
+    h = lib.vh_pool_create(64, 1, 64)
+    assert lib.vh_pool_destroy(h) == 0
+    assert lib.vh_pool_size(h) == -1
+    assert not lib.vh_pool_acquire(h, ctypes.byref(ctypes.c_int64(-1)))
+    assert lib.vh_pool_destroy(h) == -1  # double destroy

@@ -1,0 +1,334 @@
+"""Host-side runtime: aligned staging buffers and vectorized host prep.
+
+TPU-native successor to the reference's memory layer
+(/root/reference/src/memory.c:41-175) and the host half of its conversion
+kernels (inc/simd/arithmetic-inl.h:43-85).  On TPU the framework's arrays
+live in HBM under XLA's layout control, so what remains native is the
+*feed path*: cacheline-aligned, pooled host buffers that CPU code fills
+(set / reverse / widen / zero-pad, auto-vectorized C++) and hands to
+``jax.device_put`` without an intermediate copy.
+
+Everything here works without the native library too (``VELES_NO_NATIVE=1``
+or no toolchain) via NumPy fallbacks with identical semantics — the same
+dual-backend contract the reference's ``simd`` flag provided, and what the
+differential tests in tests/test_host.py exercise.
+
+API parity map (reference -> here):
+  malloc_aligned / mallocf        -> aligned_empty
+  malloc_aligned_offset           -> aligned_empty(..., offset=)
+  align_complement_{f32,i16,i32}  -> align_complement
+  memsetf                         -> memsetf
+  rmemcpyf / crmemcpyf            -> rmemcpyf / crmemcpyf
+  zeropadding / zeropaddingex     -> zeropadding / zeropaddingex
+  (new)                           -> StagingPool, to_device
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .. import shapes
+from . import _native
+
+__all__ = [
+    "native_available", "aligned_empty", "align_complement", "memsetf",
+    "rmemcpyf", "crmemcpyf", "zeropadding", "zeropaddingex", "convert",
+    "StagingPool", "to_device",
+]
+
+_CONVERSIONS = {
+    (np.dtype(np.int16), np.dtype(np.float32)): "vh_i16_to_f32",
+    (np.dtype(np.int32), np.dtype(np.float32)): "vh_i32_to_f32",
+    (np.dtype(np.float32), np.dtype(np.int16)): "vh_f32_to_i16",
+    (np.dtype(np.int32), np.dtype(np.int16)): "vh_i32_to_i16",
+    (np.dtype(np.int16), np.dtype(np.int32)): "vh_i16_to_i32",
+    (np.dtype(np.float32), np.dtype(np.int32)): "vh_f32_to_i32",
+}
+
+
+def native_available() -> bool:
+    """True when the compiled host runtime is loaded."""
+    return _native.available()
+
+
+def _ptr(a: np.ndarray) -> ctypes.c_void_p:
+    return ctypes.c_void_p(a.ctypes.data)
+
+
+class _OwnedBuffer:
+    """Keeps a native allocation alive for the ndarray viewing it."""
+
+    def __init__(self, lib, ptr: int):
+        self._lib = lib
+        self._ptr = ptr
+
+    def __del__(self):
+        try:
+            self._lib.vh_free(ctypes.c_void_p(self._ptr))
+        except Exception:  # interpreter teardown
+            pass
+
+
+def aligned_empty(shape, dtype=np.float32, *, alignment: int = 64,
+                  offset: int = 0) -> np.ndarray:
+    """Uninitialized ndarray whose data starts ``offset`` bytes past an
+    ``alignment``-byte boundary (reference: malloc_aligned memory.c:69-79,
+    malloc_aligned_offset :63-67).  Aligned host buffers let the transfer
+    engine DMA without bounce copies."""
+    dtype = np.dtype(dtype)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    lib = _native.load()
+    if lib is None:
+        raw = np.empty(nbytes + alignment + offset, dtype=np.uint8)
+        start = (-raw.ctypes.data) % alignment + offset
+        return raw[start:start + nbytes].view(dtype).reshape(shape)
+    ptr = lib.vh_alloc_aligned(nbytes + offset, alignment)
+    if not ptr:
+        raise MemoryError(f"vh_alloc_aligned({nbytes + offset}) failed")
+    buf = (ctypes.c_char * (nbytes + offset)).from_address(ptr)
+    # the ctypes buffer sits at the root of arr.base; hanging the owner off
+    # it keeps the allocation alive as long as any view of arr is
+    buf._veles_owner = _OwnedBuffer(lib, ptr)
+    arr = np.frombuffer(buf, dtype=np.uint8, count=nbytes, offset=offset)
+    arr = arr.view(dtype).reshape(shape)
+    arr.flags.writeable = True
+    return arr
+
+
+def align_complement(a: np.ndarray, alignment: int = 32) -> int:
+    """Elements until ``a``'s data pointer hits the next boundary
+    (reference: align_complement_* memory.c:41-61)."""
+    lib = _native.load()
+    if lib is None:
+        rem = a.ctypes.data % alignment
+        return 0 if rem == 0 else (alignment - rem) // a.itemsize
+    res = lib.vh_align_complement(_ptr(a), alignment, a.itemsize)
+    if res < 0:
+        raise ValueError(f"bad alignment {alignment}")
+    return int(res)
+
+
+def _check_1d_f32(a: np.ndarray, name: str) -> None:
+    if a.dtype != np.float32 or a.ndim != 1 or not a.flags.c_contiguous:
+        raise ValueError(f"{name} must be contiguous 1-D float32")
+
+
+def memsetf(dst: np.ndarray, value: float) -> np.ndarray:
+    """Vectorized fill (reference: memsetf memory.c:85-115)."""
+    _check_1d_f32(dst, "dst")
+    lib = _native.load()
+    if lib is None:
+        dst[:] = value
+    else:
+        lib.vh_fill_f32(_ptr(dst), float(value), dst.size)
+    return dst
+
+
+def rmemcpyf(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Reversed copy, dst[i] = src[n-1-i] (memory.c:136-166).  Host-side
+    kernel-reversal prep for the correlation feed path."""
+    _check_1d_f32(dst, "dst"), _check_1d_f32(src, "src")
+    if dst.size != src.size:
+        raise ValueError("length mismatch")
+    lib = _native.load()
+    if lib is None:
+        dst[:] = src[::-1]
+    else:
+        lib.vh_reverse_f32(_ptr(dst), _ptr(src), src.size)
+    return dst
+
+
+def crmemcpyf(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Complex-pairwise reversed copy over float32 pairs (memory.c:168-175):
+    (re,im) pair order reverses, pairs stay intact."""
+    _check_1d_f32(dst, "dst"), _check_1d_f32(src, "src")
+    if dst.size != src.size or src.size % 2:
+        raise ValueError("lengths must match and be even")
+    lib = _native.load()
+    if lib is None:
+        pairs = src.reshape(-1, 2)
+        dst.reshape(-1, 2)[:] = pairs[::-1]
+    else:
+        lib.vh_reverse_c64(_ptr(dst), _ptr(src), src.size)
+    return dst
+
+
+def zeropadding(src: np.ndarray) -> np.ndarray:
+    """Copy into a fresh aligned buffer padded with zeros to the pow2 policy
+    of shapes.zeropadding_length (memory.c:117-134)."""
+    return zeropaddingex(src, 0)
+
+
+def zeropaddingex(src: np.ndarray, additional_length: int) -> np.ndarray:
+    """`zeropadding` with ``additional_length`` extra (zeroed) elements —
+    the reference used them as FFT scratch (memory.c:121-134)."""
+    _check_1d_f32(src, "src")
+    if additional_length < 0:
+        raise ValueError("additional_length must be >= 0")
+    new_len = shapes.zeropadding_length(src.size)
+    out = aligned_empty(new_len + additional_length, np.float32)
+    lib = _native.load()
+    if lib is None:
+        out[:src.size] = src
+        out[src.size:] = 0.0
+    else:
+        lib.vh_zeropad_f32(_ptr(out), _ptr(src), src.size, out.size)
+    return out
+
+
+def convert(src: np.ndarray, to_dtype) -> np.ndarray:
+    """Host-side staging conversion with saturating narrows
+    (arithmetic-inl.h:43-85 semantics; device twins in ops.arithmetic)."""
+    to_dtype = np.dtype(to_dtype)
+    if src.ndim != 1 or not src.flags.c_contiguous:
+        raise ValueError("src must be contiguous 1-D")
+    key = (src.dtype, to_dtype)
+    if key not in _CONVERSIONS:
+        raise ValueError(f"unsupported conversion {src.dtype} -> {to_dtype}")
+    out = aligned_empty(src.size, to_dtype)
+    lib = _native.load()
+    if lib is None:
+        if np.issubdtype(to_dtype, np.integer) and src.dtype == np.float32:
+            # match native: NaN -> 0, out-of-range saturates
+            info = np.iinfo(to_dtype)
+            clean = np.nan_to_num(src.astype(np.float64), nan=0.0)
+            out[:] = np.clip(clean, info.min, info.max).astype(to_dtype)
+        elif to_dtype == np.int16:
+            out[:] = np.clip(src, -32768, 32767).astype(np.int16)
+        else:
+            out[:] = src.astype(to_dtype)
+    else:
+        getattr(lib, _CONVERSIONS[key])(_ptr(out), _ptr(src), src.size)
+    return out
+
+
+class StagingPool:
+    """Reusable aligned host buffers for the host->device feed path.
+
+    The reference never needed one (single process, no device); a TPU host
+    runtime does: per-batch prep must not churn the allocator, and buffers
+    handed to the transfer engine stay pinned until release.
+
+        pool = StagingPool(nbytes=4 << 20, count=4)
+        with pool.buffer((batch, n), np.float32) as buf:
+            buf[:] = batch_data            # native-filled, aligned
+            dev = to_device(buf)
+    """
+
+    def __init__(self, nbytes: int, count: int = 2, *, alignment: int = 64):
+        self._nbytes = int(nbytes)
+        self._alignment = alignment
+        self._lib = _native.load()
+        if self._lib is None:
+            self._handle = None
+            self._free = [aligned_empty(self._nbytes, np.uint8,
+                                        alignment=alignment)
+                          for _ in range(count)]
+            self._total = count
+            self._grows = 0
+            self._borrowed = set()
+        else:
+            self._handle = self._lib.vh_pool_create(self._nbytes, count,
+                                                    alignment)
+            if self._handle < 0:
+                raise MemoryError("vh_pool_create failed")
+
+    def acquire(self, shape, dtype=np.float32):
+        """-> (slot, ndarray view).  Grows the pool when all slots busy."""
+        dtype = np.dtype(dtype)
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes > self._nbytes:
+            raise ValueError(f"request {nbytes} > buffer size {self._nbytes}")
+        if self._handle is None:
+            if not self._free:
+                self._free.append(aligned_empty(self._nbytes, np.uint8,
+                                                alignment=self._alignment))
+                self._total += 1
+                self._grows += 1
+            raw = self._free.pop()
+            self._borrowed.add(id(raw))
+            return raw, raw[:nbytes].view(dtype).reshape(shape)
+        slot = ctypes.c_int64(-1)
+        ptr = self._lib.vh_pool_acquire(self._handle, ctypes.byref(slot))
+        if not ptr:
+            raise MemoryError("vh_pool_acquire failed")
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=np.uint8).view(dtype).reshape(shape)
+        arr.flags.writeable = True
+        return int(slot.value), arr
+
+    def release(self, slot) -> None:
+        if self._handle is None:
+            if id(slot) not in self._borrowed:
+                raise RuntimeError("double release or foreign slot")
+            self._borrowed.discard(id(slot))
+            self._free.append(slot)
+            return
+        rc = self._lib.vh_pool_release(self._handle, slot)
+        if rc == -2:
+            raise RuntimeError(f"double release of slot {slot}")
+        if rc != 0:
+            raise ValueError(f"bad slot {slot}")
+
+    class _Lease:
+        def __init__(self, pool, shape, dtype):
+            self._pool, self._shape, self._dtype = pool, shape, dtype
+            self._slot = None
+
+        def __enter__(self):
+            self._slot, arr = self._pool.acquire(self._shape, self._dtype)
+            return arr
+
+        def __exit__(self, *exc):
+            self._pool.release(self._slot)
+            return False
+
+    def buffer(self, shape, dtype=np.float32):
+        """Context manager lease: acquire on enter, release on exit."""
+        return self._Lease(self, shape, dtype)
+
+    @property
+    def size(self) -> int:
+        """Current slot count (grows under contention)."""
+        if self._handle is None:
+            return self._total
+        return int(self._lib.vh_pool_size(self._handle))
+
+    @property
+    def grow_count(self) -> int:
+        if self._handle is None:
+            return self._grows
+        return int(self._lib.vh_pool_grows(self._handle))
+
+    def close(self) -> None:
+        """Free pooled buffers.  Refuses while leases are outstanding —
+        their buffers back live ndarray views."""
+        if self._handle is None:
+            if self._borrowed:
+                raise RuntimeError(
+                    f"{len(self._borrowed)} leases still outstanding")
+            self._free = []
+            return
+        if self._handle >= 0:
+            rc = self._lib.vh_pool_destroy(self._handle)
+            if rc == -2:
+                raise RuntimeError("leases still outstanding")
+            self._handle = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def to_device(host_array: np.ndarray, sharding=None):
+    """``jax.device_put`` of a staged buffer (copies out of the pool —
+    release the lease after this returns)."""
+    import jax
+    return jax.device_put(np.ascontiguousarray(host_array), sharding)

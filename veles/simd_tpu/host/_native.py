@@ -1,0 +1,115 @@
+"""ctypes loader for the native host runtime (``native/veles_host.cpp``).
+
+Builds ``libveles_host.so`` on first use with g++ (cached next to the
+source, keyed on source mtime) and exposes the C ABI with typed
+signatures.  If no toolchain is available the caller falls back to pure
+NumPy — same semantics, slower staging.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))),
+    "native", "veles_host.cpp")
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+ABI_VERSION = 1
+
+
+def _build(src: str, out: str) -> bool:
+    base = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
+            "-fvisibility=hidden", "-o", out, src]
+    for extra in (["-march=native"], []):
+        try:
+            r = subprocess.run(base[:6] + extra + base[6:],
+                               capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if r.returncode == 0:
+            return True
+    return False
+
+
+def _signatures(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.vh_alloc_aligned.restype = c.c_void_p
+    lib.vh_alloc_aligned.argtypes = [c.c_size_t, c.c_size_t]
+    lib.vh_free.restype = None
+    lib.vh_free.argtypes = [c.c_void_p]
+    lib.vh_align_complement.restype = c.c_int64
+    lib.vh_align_complement.argtypes = [c.c_void_p, c.c_size_t, c.c_size_t]
+    lib.vh_fill_f32.restype = None
+    lib.vh_fill_f32.argtypes = [c.c_void_p, c.c_float, c.c_size_t]
+    for name in ("vh_reverse_f32", "vh_reverse_c64"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [c.c_void_p, c.c_void_p, c.c_size_t]
+    lib.vh_zeropad_f32.restype = None
+    lib.vh_zeropad_f32.argtypes = [c.c_void_p, c.c_void_p, c.c_size_t,
+                                   c.c_size_t]
+    for name in ("vh_i16_to_f32", "vh_i32_to_f32", "vh_f32_to_i16",
+                 "vh_i32_to_i16", "vh_i16_to_i32", "vh_f32_to_i32"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [c.c_void_p, c.c_void_p, c.c_size_t]
+    lib.vh_pool_create.restype = c.c_int64
+    lib.vh_pool_create.argtypes = [c.c_size_t, c.c_size_t, c.c_size_t]
+    lib.vh_pool_acquire.restype = c.c_void_p
+    lib.vh_pool_acquire.argtypes = [c.c_int64, c.POINTER(c.c_int64)]
+    lib.vh_pool_release.restype = c.c_int
+    lib.vh_pool_release.argtypes = [c.c_int64, c.c_int64]
+    for name in ("vh_pool_size", "vh_pool_grows"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_int64]
+    lib.vh_pool_destroy.restype = c.c_int
+    lib.vh_pool_destroy.argtypes = [c.c_int64]
+    lib.vh_abi_version.restype = c.c_int
+    lib.vh_abi_version.argtypes = []
+
+
+def load():
+    """Return the loaded CDLL, or None when native is unavailable."""
+    global _LIB, _TRIED
+    if _TRIED:  # lock-free fast path — every host op calls this
+        return _LIB
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _LIB = _load_locked()
+        _TRIED = True  # written after _LIB so the fast path never races
+        return _LIB
+
+
+def _load_locked():
+    if os.environ.get("VELES_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SRC):
+        return None
+    so = os.path.join(os.path.dirname(_SRC), "libveles_host.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(_SRC)):
+        tmp = so + f".tmp.{os.getpid()}"
+        if not _build(_SRC, tmp):
+            return None
+        os.replace(tmp, so)  # atomic vs concurrent builders
+    try:
+        lib = ctypes.CDLL(so)
+        _signatures(lib)
+        if lib.vh_abi_version() != ABI_VERSION:
+            return None
+    except OSError:
+        return None
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
