@@ -6,10 +6,10 @@ reported as achieved GFLOPS. ``vs_baseline`` is the ratio against the
 north-star target of 50% MXU utilization at the v5e bf16 peak
 (0.5 * 197 TFLOPS = 98.5 TFLOPS); >= 1.0 means the target is met.
 
-Measurement method: the op is iterated inside one jit'd lax.scan with a data
-dependency between steps (the axon tunnel defers execution past
-block_until_ready, so wall-clocking individual dispatches measures nothing —
-a chained scan with a scalar checksum fetch is the only honest clock here).
+Measurement method: utils/benchlib.py — the op is iterated inside one jit'd
+lax.scan with a data dependency between steps, and a null chain's total is
+subtracted (the axon tunnel defers execution past block_until_ready and
+adds a ~70 ms round trip, so per-dispatch wall-clocking measures nothing).
 
 ``python bench.py --all`` additionally reports the secondary BASELINE
 configs on stderr as they come online.
@@ -18,33 +18,11 @@ configs on stderr as they come online.
 import argparse
 import json
 import sys
-import time
 
 import numpy as np
 
 V5E_BF16_PEAK_GFLOPS = 197_000.0
 TARGET_GFLOPS = 0.5 * V5E_BF16_PEAK_GFLOPS
-
-
-def _bench_chain(step_fn, carry, iters):
-    """Time iters sequential applications of step_fn inside one jit."""
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def chain(c):
-        def body(c, _):
-            return step_fn(c), None
-        c, _ = jax.lax.scan(body, c, None, length=iters)
-        return jnp.sum(c.astype(jnp.float32))
-
-    float(chain(carry))  # compile + warm
-    t0 = time.perf_counter()
-    checksum = float(chain(carry))
-    dt = (time.perf_counter() - t0) / iters
-    if not np.isfinite(checksum):
-        raise RuntimeError(f"non-finite checksum {checksum}")
-    return dt
 
 
 def bench_matmul_4096():
@@ -59,8 +37,9 @@ def bench_matmul_4096():
     b = jax.random.normal(k2, (n, n), jnp.float32) / jnp.float32(np.sqrt(n))
 
     from veles.simd_tpu import ops
+    from veles.simd_tpu.utils.benchlib import chain_time
 
-    dt = _bench_chain(lambda c: ops.matrix_multiply(c, b), a, iters)
+    dt = chain_time(lambda c: ops.matrix_multiply(c, b), a, iters)
     gflops = 2 * n ** 3 / dt / 1e9
     return {
         "metric": f"matrix_multiply_f32_n{n}",

@@ -77,6 +77,27 @@ def test_selector_contract():
     assert ops.select_algorithm(64, 16) == "direct"
     assert ops.convolve_initialize(65536, 127).algorithm == "overlap_save"
     assert ops.convolve_initialize(64, 16).algorithm == "direct"
+    # TPU-measured refinements (tools/tune_convolve.py table):
+    # large kernels never take the per-tap-unrolled direct path
+    assert ops.select_algorithm(4096, 1024) == "fft"
+    # batched block FFT wins as soon as there are >= 2 blocks to batch
+    assert ops.select_algorithm(16384, 127) == "overlap_save"
+    # mid-size signals (latency-bound but above the brute cutoff) take fft
+    assert ops.select_algorithm(4096, 127) == "fft"
+
+
+def test_os_block_policy():
+    from veles.simd_tpu.ops.convolve import os_block_length
+    from veles.simd_tpu.shapes import overlap_save_fft_length
+
+    # TPU floor of 8192 dominates for small kernels...
+    assert os_block_length(127) == 8192
+    assert os_block_length(4000) == 8192
+    # ...and the reference 2x-next-pow2 policy takes over beyond it
+    assert os_block_length(8191) == overlap_save_fft_length(8191) == 16384
+    # block must always fit the kernel with room for a useful step
+    for m in (3, 127, 1023, 8191):
+        assert os_block_length(m) > 2 * m
 
 
 def test_handle_api(rng):

@@ -84,6 +84,21 @@ def test_crmemcpyf(n):
     np.testing.assert_array_equal(dst, expect)
 
 
+def test_rmemcpyf_aliased_inplace():
+    a = host.aligned_empty(101, np.float32)
+    a[:] = np.arange(101, dtype=np.float32)
+    host.rmemcpyf(a, a)
+    np.testing.assert_array_equal(a, np.arange(101, dtype=np.float32)[::-1])
+
+
+def test_crmemcpyf_aliased_inplace():
+    a = host.aligned_empty(10, np.float32)
+    a[:] = np.arange(10, dtype=np.float32)
+    host.crmemcpyf(a, a)
+    expect = np.arange(10, dtype=np.float32).reshape(-1, 2)[::-1].reshape(-1)
+    np.testing.assert_array_equal(a, expect)
+
+
 def test_crmemcpyf_odd_rejected():
     a = host.aligned_empty(3, np.float32)
     with pytest.raises(ValueError):

@@ -2,60 +2,66 @@
 
 The reference tuned its CPU constants empirically (convolve.c:328-366:
 overlap-save when x > 2h && x > 200; FFT when x > 350 on x86 / 50 on ARM).
-This script produces the TPU equivalents feeding ops/convolve.py's
-_OS_MIN_X / _FFT_MIN_WORK policy constants.
+This script produces the TPU equivalents feeding ops/convolve.py's policy
+constants (_OS_MIN_X, _DIRECT_MAX_H, _DIRECT_MAX_X, _OS_BLOCK_MIN).
+
+Timing uses utils/benchlib.py: every algorithm is an iters-long chained
+lax.scan, all candidates for one shape run interleaved in one process, and
+a null chain's total is subtracted. Anything less lies here — the axon
+tunnel's ~70 ms round trip swallows small workloads (naive per-dispatch
+timing showed every algorithm at an identical 14 "MSamples/s"), and chip
+throughput drifts ~2x between runs, so only same-process interleaved
+comparisons are meaningful.
+
+Measured on v5e-1, 2026-07-29 (MSamples/s; os = overlap-save, L=8192,
+reshape/concat block extraction — the gather formulation is 9x slower):
+
+    x=4096    h=127 : direct 365   fft 3108
+    x=65536   h=127 : direct 200   fft  251-650   os 2891
+    x=262144  h=127 :              fft  465       os  701
+    x=1048576 h=127 :              fft 1012       os 1178
+    x=4194304 h=127 :              fft  593       os 2141
+    x=65536   h=2047:              fft  590       os 1835
 
 Run on a TPU host:  python tools/tune_convolve.py
 """
 
-import time
-
 import numpy as np
-
-
-def bench(fn, iters=5):
-    """Time fn() forcing execution with a 4-byte scalar fetch per iteration.
-
-    The axon tunnel defers execution past block_until_ready, so a host fetch
-    is the only reliable fence; fetching a single element keeps the transfer
-    out of the measurement (inputs must be device-resident already).
-    """
-    float(np.asarray(fn()).ravel()[0])  # compile + warm
-    t0 = time.perf_counter()
-    acc = 0.0
-    for _ in range(iters):
-        acc += float(np.asarray(fn().ravel()[0]))
-    dt = (time.perf_counter() - t0) / iters
-    return dt
 
 
 def main():
     import jax
 
-    from veles.simd_tpu import ops
+    import veles.simd_tpu.ops.convolve  # noqa: F401  (module, not the fn)
+    import sys
+    C = sys.modules["veles.simd_tpu.ops.convolve"]
+    from veles.simd_tpu.utils.benchlib import chain_times
 
     print("backend:", jax.default_backend())
     rng = np.random.default_rng(0)
-    grid_x = [1024, 16384, 65536, 262144]
-    grid_h = [127, 2047]
-    print(f"{'x':>8} {'h':>6} {'direct':>10} {'fft':>10} {'overlap':>10}  best")
-    for x_len in grid_x:
-        for h_len in grid_h:
-            if h_len * 4 > x_len:
+    grid = [(4096, 127), (65536, 127), (262144, 127), (65536, 2047)]
+    print(f"{'x':>8} {'h':>6} {'direct':>10} {'fft':>10} {'overlap':>10}  "
+          f"best  [MSamples/s]")
+    for x_len, h_len in grid:
+        x = jax.device_put(rng.normal(size=x_len).astype(np.float32))
+        h = jax.device_put(
+            (rng.normal(size=h_len) / h_len).astype(np.float32))
+        steps = {}
+        for alg in ("direct", "fft", "overlap_save"):
+            if alg == "direct" and h_len > C._DIRECT_MAX_H:
+                continue  # per-tap unroll: compile time explodes
+            try:
+                handle = C.convolve_initialize(x_len, h_len, algorithm=alg)
+            except ValueError:
                 continue
-            x = jax.device_put(rng.normal(size=x_len).astype(np.float32))
-            h = jax.device_put(rng.normal(size=h_len).astype(np.float32))
-            times = {}
-            for alg in ("direct", "fft", "overlap_save"):
-                try:
-                    times[alg] = bench(
-                        lambda a=alg: ops.convolve(x, h, algorithm=a))
-                except ValueError:
-                    times[alg] = float("nan")
-            best = min(times, key=lambda k: times[k])
-            print(f"{x_len:>8} {h_len:>6} "
-                  f"{times['direct']*1e3:>9.3f}ms {times['fft']*1e3:>9.3f}ms "
-                  f"{times['overlap_save']*1e3:>9.3f}ms  {best}")
+            # fixed-shape carry: truncate the full conv back to x_len
+            steps[alg] = lambda c, f=handle: f(c, h)[:x_len]
+        times = chain_times(steps, x, iters=256)
+        rates = {a: x_len / dt / 1e6 for a, dt in times.items()}
+        best = max(rates, key=rates.get)
+        cells = [f"{rates.get(a, float('nan')):>10.1f}"
+                 for a in ("direct", "fft", "overlap_save")]
+        print(f"{x_len:>8} {h_len:>6} " + " ".join(cells) + f"  {best}")
 
 
 if __name__ == "__main__":

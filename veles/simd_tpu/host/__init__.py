@@ -134,7 +134,9 @@ def rmemcpyf(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
     if dst.size != src.size:
         raise ValueError("length mismatch")
     lib = _native.load()
-    if lib is None:
+    if lib is None or np.shares_memory(dst, src):
+        # the native kernel is __restrict; aliased in-place reversal must
+        # take the buffered path
         dst[:] = src[::-1]
     else:
         lib.vh_reverse_f32(_ptr(dst), _ptr(src), src.size)
@@ -148,9 +150,9 @@ def crmemcpyf(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
     if dst.size != src.size or src.size % 2:
         raise ValueError("lengths must match and be even")
     lib = _native.load()
-    if lib is None:
-        pairs = src.reshape(-1, 2)
-        dst.reshape(-1, 2)[:] = pairs[::-1]
+    if lib is None or np.shares_memory(dst, src):
+        # aliasing: see rmemcpyf
+        dst.reshape(-1, 2)[:] = src.reshape(-1, 2)[::-1].copy()
     else:
         lib.vh_reverse_c64(_ptr(dst), _ptr(src), src.size)
     return dst

@@ -42,41 +42,76 @@ from veles.simd_tpu.shapes import (fft_convolution_length,
 
 ALGORITHMS = ("direct", "fft", "overlap_save")
 
-# TPU crossover policy (structure mirrors convolve.c:328-366; constants are
-# initial estimates pending measurement with tools/tune_convolve.py — see
-# module docstring): direct convolution on the MXU/VPU stays competitive far
-# longer than CPU brute force, so the FFT paths only win once the h*x work
-# is substantial.
-_OS_MIN_X = 8192        # overlap-save needs x >> h and enough blocks to batch
-_FFT_MIN_WORK = 1 << 22  # x*h above which full-FFT beats direct
+# TPU crossover policy, measured on a v5e chip (chained-scan timing with a
+# null-chain RTT correction — the axon tunnel's ~70 ms round trip swallows
+# small workloads, so every config is timed interleaved in one process and
+# the null chain's total is subtracted; tools/tune_convolve.py reproduces
+# the table).  MSamples/s, 2026-07-29:
+#
+#   x=4096    h=127 : direct 365   fft 3108
+#   x=65536   h=127 : direct 200   fft 251-650   os(L=8192) 2891
+#   x=262144  h=127 :              fft 465       os 701
+#   x=1048576 h=127 :              fft 1012      os 1178
+#   x=4194304 h=127 :              fft 593       os 2141
+#   x=65536   h=2047:              fft 590       os 1835
+#
+# Structure mirrors convolve.c:328-366; the constants are TPU-measured.
+# Three TPU-specific facts drive them: (a) per-tap unrolling makes direct's
+# compile time linear in h, so large kernels must never take it; (b) the
+# batched block FFT beats one full-length FFT once there are >= 2 blocks to
+# batch; (c) block extraction must be reshape/concat, never gather — the
+# gather formulation ran 9x slower (131 vs 1178 MS/s at x=1M).
+_OS_MIN_X = 16384       # >= 2 blocks of the 8192 floor: overlap-save wins
+_DIRECT_MAX_H = 512     # above this, per-tap unroll compile cost explodes
+_DIRECT_MAX_X = 1024    # tiny signals are latency-bound; keep brute parity
+_OS_BLOCK_MIN = 8192    # TPU-efficient FFT block floor (CPU policy was 4*h)
 
 
 def select_algorithm(x_length: int, h_length: int) -> str:
     """Shape-driven algorithm choice (the convolve_initialize policy)."""
-    if x_length > 2 * h_length and x_length > _OS_MIN_X:
+    if x_length > 2 * h_length and x_length >= _OS_MIN_X:
         return "overlap_save"
-    if x_length * h_length > _FFT_MIN_WORK:
-        return "fft"
-    return "direct"
+    if x_length <= _DIRECT_MAX_X and h_length <= _DIRECT_MAX_H:
+        return "direct"
+    return "fft"
+
+
+def os_block_length(h_length: int) -> int:
+    """Overlap-save FFT block size L, TPU policy.
+
+    The reference used L = ~4*next_pow2(h) (convolve.c:115-128) — sized for
+    CPU cache. TPU FFT throughput needs L >= ~8192 before the batched rfft
+    amortizes (measured: h=127 at x=65536 runs 14 MS/s with L=512 vs 31 MS/s
+    with L=8192), so L = max(8192, reference policy).
+    """
+    return max(_OS_BLOCK_MIN, overlap_save_fft_length(h_length))
 
 
 # ---------------------------------------------------------------------------
-# direct (brute force) — lax.conv_general_dilated
+# direct (brute force) — per-tap static slices + MXU contraction
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("reverse",))
 def _convolve_direct_xla(x, h, reverse=False):
+    """Windowed matmul formulation of brute-force convolution.
+
+    The reference's per-output SIMD dot (convolve.c:40-101) does not map to
+    TPU: lax.conv_general_dilated with N=C=1 lowers to a degenerate conv
+    whose compile time grows superlinearly in the signal length (measured
+    53s at x=4096) and runs <1 MS/s. Instead, materialize the h overlapping
+    tap-diagonals with static contiguous slices (no gather — TPU gathers
+    serialize) and contract on the MXU: out = h_rev @ windows(m, x+m-1).
+    """
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     if not reverse:
-        h = h[::-1]  # conv_general_dilated correlates; flip for convolution
+        h = h[::-1]
     n, m = x.shape[-1], h.shape[-1]
-    lhs = x.reshape(1, 1, n)
-    rhs = h.reshape(1, 1, m)
-    out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)],
-        dimension_numbers=("NCH", "OIH", "NCH"))
-    return out.reshape(n + m - 1)
+    n_out = n + m - 1
+    padded = jnp.pad(x, (m - 1, m - 1))
+    windows = jnp.stack(
+        [jax.lax.slice_in_dim(padded, j, j + n_out) for j in range(m)])
+    return (h @ windows).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -112,16 +147,25 @@ def _convolve_overlap_save_xla(x, h, L, out_length, reverse=False):
         h = h[::-1]
     m = h.shape[-1]
     step = L - (m - 1)
+    if step < m - 1:
+        raise ValueError(
+            f"overlap-save needs L >= 2*(h-1) so each block's halo fits in "
+            f"the next block body; got L={L}, h={m}")
     n_blocks = -(-out_length // step)
     # X = [zeros(M-1), x, zeros(...)] — the index arithmetic of
-    # convolve.c:181-228 becomes one gather of overlapping windows.
-    padded = jnp.pad(x, (m - 1, n_blocks * step + L - (m - 1) - x.shape[-1]))
-    idx = jnp.arange(n_blocks)[:, None] * step + jnp.arange(L)[None, :]
-    blocks = padded[idx]                              # (n_blocks, L)
+    # convolve.c:181-228. The overlapping windows are materialized with two
+    # strided reshapes + a concat (block body / next block's first m-1
+    # samples), never a gather: TPU gathers serialize, and this exact
+    # formulation is 9x faster (see policy table above).
+    total = (n_blocks + 1) * step
+    padded = jnp.pad(x, (m - 1, total - x.shape[-1]))   # (total + m - 1,)
+    body = padded[:n_blocks * step].reshape(n_blocks, step)
+    halo = padded[step:(n_blocks + 1) * step].reshape(n_blocks, step)[:, :m - 1]
+    blocks = jnp.concatenate([body, halo], axis=1)      # (n_blocks, L)
     H = jnp.fft.rfft(jnp.pad(h, (0, L - m)))
-    spectra = jnp.fft.rfft(blocks, axis=-1)           # batched: all blocks
+    spectra = jnp.fft.rfft(blocks, axis=-1)             # batched: all blocks
     conv = jnp.fft.irfft(spectra * H[None, :], n=L, axis=-1)
-    useful = conv[:, m - 1:]                          # step samples per block
+    useful = conv[:, m - 1:]                            # step samples per block
     return useful.reshape(-1)[:out_length].astype(jnp.float32)
 
 
@@ -177,7 +221,7 @@ def convolve_initialize(x_length: int, h_length: int,
             raise ValueError(
                 "overlap_save requires h_length < x_length / 2 "
                 "(convolve.c:105 assert)")
-        L = overlap_save_fft_length(h_length)
+        L = os_block_length(h_length)
         fn = functools.partial(_convolve_overlap_save_xla, L=L,
                                out_length=out_length, reverse=reverse)
     return ConvolutionHandle(x_length, h_length, algorithm, reverse, fn)

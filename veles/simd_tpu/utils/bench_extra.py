@@ -11,35 +11,16 @@ fill the remaining BASELINE table rows:
   * batched normalize + detect_peaks over 256 signals
     (normalize.c:435-441 + detect_peaks.c:58-127 under vmap)
 
-Timing method matches bench.py: iterations chained inside one jitted
-lax.scan with a data dependency, ending in a scalar checksum fetch (the
-axon tunnel defers execution, so per-dispatch wall-clocking is dishonest).
+Timing: utils/benchlib.py protocol — chained lax.scan per config with a
+null-chain RTT correction. Iteration counts are sized so device time is
+several times the ~70 ms tunnel round trip.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
-
-def _chain_time(step_fn, carry, iters):
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def chain(c):
-        def body(c, _):
-            return step_fn(c), None
-        c, _ = jax.lax.scan(body, c, None, length=iters)
-        leaves = jax.tree_util.tree_leaves(c)
-        return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
-
-    float(chain(carry))  # compile + warm
-    t0 = time.perf_counter()
-    checksum = float(chain(carry))
-    dt = (time.perf_counter() - t0) / iters
-    assert checksum == checksum, "NaN checksum"
-    return dt
+from veles.simd_tpu.utils.benchlib import chain_time
 
 
 def bench_elementwise(scale=1):
@@ -53,7 +34,7 @@ def bench_elementwise(scale=1):
         # add / mul / scale fused round-trip (tests/arithmetic.cc kernels)
         return (c + c) * c * jnp.float32(0.5)
 
-    dt = _chain_time(step, x, 32)
+    dt = chain_time(step, x, iters=2048)
     return {"metric": f"elementwise_add_mul_scale_n{n}",
             "value": round(n * 3 / dt / 1e9, 2), "unit": "Gop/s",
             "vs_baseline": None}
@@ -63,20 +44,22 @@ def bench_convolve(scale=1):
     import jax.numpy as jnp
     import numpy as np
 
-    from veles.simd_tpu.ops.convolve import _convolve_overlap_save_xla
-    from veles.simd_tpu.shapes import overlap_save_fft_length
+    from veles.simd_tpu.ops.convolve import (_convolve_overlap_save_xla,
+                                             os_block_length)
 
     n, m = int(65536 * scale), 127
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=n).astype(np.float32))
     h = jnp.asarray(rng.normal(size=m).astype(np.float32) / m)
-    L = overlap_save_fft_length(m)
+    L = os_block_length(m)
+    if L > n:  # CPU smoke fallback scale shrinks n below the block floor
+        L = max(256, 2 * m)
 
     def step(c):
         out = _convolve_overlap_save_xla(c, h, L=L, out_length=n + m - 1)
         return out[:n]  # keep the carry shape fixed
 
-    dt = _chain_time(step, x, 16)
+    dt = chain_time(step, x, iters=1024)
     return {"metric": f"convolve_os_n{n}_m{m}",
             "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}
@@ -106,15 +89,13 @@ def bench_dwt(scale=1):
         # fold the cascade back into a fixed-shape carry
         return c + jnp.pad(lo_band * 0, (0, n - lo_band.shape[-1])) + acc / n
 
-    dt = _chain_time(six_level, x, 16)
-    # samples processed across the cascade: n + n/2 + ... ~ 2n input samples
+    dt = chain_time(six_level, x, iters=256)
     return {"metric": f"dwt_db8_6level_n{n}",
             "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}
 
 
 def bench_batched_pipeline(scale=1):
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -130,7 +111,7 @@ def bench_batched_pipeline(scale=1):
         _, vals, _ = _detect_peaks_fixed_xla(norm, 3, 64)
         return norm + jnp.float32(1e-6) * jnp.sum(vals) / n
 
-    dt = _chain_time(step, x, 16)
+    dt = chain_time(step, x, iters=256)
     return {"metric": f"normalize_peaks_b{batch}_n{n}",
             "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}

@@ -1,0 +1,80 @@
+"""Honest timing on a tunneled TPU: chained scans + RTT correction.
+
+Two facts about this environment make naive benchmarking lie:
+
+1. The axon tunnel defers execution past ``block_until_ready``, so timing
+   individual dispatches measures the ~70 ms host<->TPU round trip, not the
+   op (every config "runs" at the same speed).
+2. The round trip itself varies between runs, so configs timed in separate
+   processes are not comparable.
+
+The protocol here fixes both: every candidate is an ``iters``-long
+``lax.scan`` chain with a data dependency between steps (one round trip per
+chain), a null chain measures the round-trip + scan overhead floor, all
+chains run interleaved over ``reps`` rounds in one process, and each
+config's best total minus the null floor is the device time.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def make_chain(step_fn, iters: int):
+    """jit(c -> checksum) applying step_fn iters times with a carried dep."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(c):
+        def body(c, _):
+            return step_fn(c), None
+        c, _ = jax.lax.scan(body, c, None, length=iters)
+        leaves = jax.tree_util.tree_leaves(c)
+        return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
+
+    return chain
+
+
+def chain_times(steps: dict, carry, iters: int, reps: int = 3) -> dict:
+    """Per-step seconds for each named step fn, RTT-corrected.
+
+    ``steps`` maps name -> (carry -> carry). All configs (plus an implicit
+    null chain) are compiled up front, then timed interleaved; returns
+    {name: seconds_per_step}. Raises on non-finite checksums.
+    """
+    import math
+
+    import jax.numpy as jnp
+
+    chains = {"__null__": make_chain(lambda c: c * jnp.float32(1.0000001),
+                                     iters)}
+    for name, fn in steps.items():
+        chains[name] = make_chain(fn, iters)
+
+    for name, chain in chains.items():
+        value = float(chain(carry))  # compile + warm
+        if not math.isfinite(value):
+            raise RuntimeError(f"non-finite checksum from {name}: {value}")
+
+    best = {name: float("inf") for name in chains}
+    for _ in range(reps):
+        for name, chain in chains.items():
+            t0 = time.perf_counter()
+            float(chain(carry))
+            best[name] = min(best[name], time.perf_counter() - t0)
+
+    floor = best.pop("__null__")
+    for name, total in best.items():
+        if total <= floor * 1.05:
+            raise RuntimeError(
+                f"config '{name}' ({total * 1e3:.1f} ms) is indistinguishable "
+                f"from the RTT floor ({floor * 1e3:.1f} ms); raise iters so "
+                f"device time dominates — reporting a corrected rate here "
+                f"would be noise")
+    return {name: (total - floor) / iters for name, total in best.items()}
+
+
+def chain_time(step_fn, carry, iters: int, reps: int = 3) -> float:
+    """Single-config convenience wrapper over chain_times."""
+    return chain_times({"_": step_fn}, carry, iters, reps)["_"]
