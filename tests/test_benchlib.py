@@ -1,0 +1,53 @@
+"""Timing-harness unit tests (the benchmark.inc analogue's plumbing).
+
+Rates themselves are only meaningful on hardware; these cover the chain
+construction contracts on CPU with tiny shapes.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veles.simd_tpu.utils.benchlib import chain_time, chain_times, make_chain
+
+
+def test_make_chain_applies_step_iters_times():
+    chain = make_chain(lambda c: c + 1.0, 5)
+    out = float(chain(jnp.zeros(3, jnp.float32)))
+    assert out == pytest.approx(15.0)  # 3 leaves x 5 increments
+
+
+def test_pytree_carry():
+    # the null chain must compile for dict carries (tree_map, not c * s)
+    carry = {"a": jnp.ones(4, jnp.float32), "b": jnp.zeros(2, jnp.float32)}
+    times = chain_times(
+        {"_": lambda c: {"a": c["a"] * 1.0, "b": c["b"] + c["a"][:2]}},
+        carry, iters=4, reps=1, on_floor="nan")
+    dt = times["_"]
+    assert math.isfinite(dt) or math.isnan(dt)  # tiny op may sit at floor
+
+
+def test_non_finite_checksum_raises():
+    with pytest.raises(RuntimeError, match="non-finite"):
+        chain_time(lambda c: c * jnp.float32(2.0),
+                   jnp.full(4, 1e30, jnp.float32), iters=64, reps=1)
+
+
+def test_on_floor_nan_keeps_other_configs():
+    carry = jnp.ones(8, jnp.float32)
+    steps = {
+        "free": lambda c: c,  # guaranteed at the RTT floor
+        "work": lambda c: jnp.fft.rfft(jnp.tile(c, 4096)).real[:8] * 0 + c,
+    }
+    times = chain_times(steps, carry, iters=32, reps=1, on_floor="nan")
+    assert math.isnan(times["free"])
+    assert math.isfinite(times["work"]) and times["work"] > 0
+
+
+def test_on_floor_raise_default():
+    with pytest.raises(RuntimeError, match="floor"):
+        chain_times({"free": lambda c: c}, jnp.ones(8, jnp.float32),
+                    iters=32, reps=1)

@@ -129,3 +129,25 @@ def test_baseline_config(rng):
     got = np.asarray(ops.convolve(x, h, algorithm="overlap_save"))
     ref = ops.convolve(x, h, impl="reference")
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-3)
+
+
+class TestDirectOversizeFallback:
+    """Explicit algorithm="direct" beyond the windows-matrix budget must
+    still return a result (O(n)-memory conv lowering, not a 16 GB stack)."""
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    def test_fallback_matches_windowed(self, rng, monkeypatch, reverse):
+        import importlib
+        # ops.convolve the *function* shadows the submodule attribute, so
+        # "import ... as C" would bind the function; go via import_module
+        C = importlib.import_module("veles.simd_tpu.ops.convolve")
+        x = rng.normal(size=300).astype(np.float32)
+        h = rng.normal(size=40).astype(np.float32)
+        want = np.asarray(C._convolve_direct_xla(x, h, reverse=reverse))
+        monkeypatch.setattr(C, "_DIRECT_WINDOWS_MAX_ELEMS", 1)
+        C._convolve_direct_xla.clear_cache()
+        try:
+            got = np.asarray(C._convolve_direct_xla(x, h, reverse=reverse))
+        finally:
+            C._convolve_direct_xla.clear_cache()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)

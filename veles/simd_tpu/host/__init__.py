@@ -152,7 +152,7 @@ def crmemcpyf(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
     lib = _native.load()
     if lib is None or np.shares_memory(dst, src):
         # aliasing: see rmemcpyf
-        dst.reshape(-1, 2)[:] = src.reshape(-1, 2)[::-1].copy()
+        dst.reshape(-1, 2)[:] = src.reshape(-1, 2)[::-1]
     else:
         lib.vh_reverse_c64(_ptr(dst), _ptr(src), src.size)
     return dst

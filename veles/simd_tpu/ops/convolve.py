@@ -3,12 +3,17 @@
 All three algorithms compute the full linear convolution (length x+h-1):
 
 * ``direct``       — the brute-force path (convolve.c:40-101). On TPU this
-  is one lax.conv_general_dilated call; the MXU eats small-kernel dots.
+  is a windowed-matmul: the h tap-diagonals are materialized with static
+  contiguous slices and contracted on the MXU (see _convolve_direct_xla;
+  the degenerate N=C=1 conv_general_dilated lowering compiles superlinearly
+  and runs <1 MS/s, so it is only the O(n)-memory fallback for oversized
+  explicit-direct requests).
 * ``fft``          — pad to M = next_pow2(x+h-1), batched rfft of {x, h},
   pointwise complex product, irfft (convolve.c:231-326 minus the FFTF
   dependency — XLA owns the FFT).
 * ``overlap_save`` — block FFT convolution with block size
-  L = ~4*next_pow2(h) and step L-(h-1) (convolve.c:103-229). The reference
+  L = max(8192, next_pow2(2h)) and step L-(h-1) (convolve.c:103-229,
+  block floor retuned for TPU — see os_block_length). The reference
   processes blocks serially because its FFT plan shares one scratch buffer
   (convolve.c:179-180); here every block runs in parallel as one batched
   FFT — the TPU-native schedule, and the block decomposition that later
@@ -21,9 +26,9 @@ for API parity and is a no-op — XLA owns plan/buffer lifetimes.
 
 Algorithm thresholds: the reference's empirical crossovers (x > 2h && x >
 200 -> overlap-save; x > 350 -> FFT, convolve.c:328-366) are CPU constants.
-The TPU constants below are initial estimates based on the MXU/VPU handling
-direct convolution far longer than CPU brute force; re-tune with
-tools/tune_convolve.py on TPU hardware and record the measured table here.
+The TPU constants below were measured on a v5e chip with
+tools/tune_convolve.py; the measured table and the three TPU facts behind
+it are recorded at the policy block below.
 """
 
 from __future__ import annotations
@@ -62,6 +67,9 @@ ALGORITHMS = ("direct", "fft", "overlap_save")
 # batch; (c) block extraction must be reshape/concat, never gather — the
 # gather formulation ran 9x slower (131 vs 1178 MS/s at x=1M).
 _OS_MIN_X = 16384       # >= 2 blocks of the 8192 floor: overlap-save wins
+# windows-matrix budget for the direct path: 2^26 float32 = 256 MB; past
+# this, explicit-direct falls back to the O(n)-memory conv lowering
+_DIRECT_WINDOWS_MAX_ELEMS = 1 << 26
 _DIRECT_MAX_H = 512     # above this, per-tap unroll compile cost explodes
 _DIRECT_MAX_X = 1024    # tiny signals are latency-bound; keep brute parity
 _OS_BLOCK_MIN = 8192    # TPU-efficient FFT block floor (CPU policy was 4*h)
@@ -101,6 +109,12 @@ def _convolve_direct_xla(x, h, reverse=False):
     53s at x=4096) and runs <1 MS/s. Instead, materialize the h overlapping
     tap-diagonals with static contiguous slices (no gather — TPU gathers
     serialize) and contract on the MXU: out = h_rev @ windows(m, x+m-1).
+
+    The windows matrix is O(m*n) memory — fine in the regime the selector
+    routes here (x <= 1024, h <= 512) but a blowup for oversized explicit
+    ``algorithm="direct"`` requests, which instead take the degenerate
+    conv_general_dilated lowering: O(n) memory, slow to compile, but it
+    returns a result where the windowed form would OOM.
     """
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
@@ -108,6 +122,15 @@ def _convolve_direct_xla(x, h, reverse=False):
         h = h[::-1]
     n, m = x.shape[-1], h.shape[-1]
     n_out = n + m - 1
+    if m * n_out > _DIRECT_WINDOWS_MAX_ELEMS:
+        # lax conv is cross-correlation (no kernel flip) — h is already in
+        # correlation orientation here, same as the windowed branch below
+        lhs = x.reshape(1, 1, n)
+        rhs = h.reshape(1, 1, m)
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)],
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        return out.reshape(n_out)
     padded = jnp.pad(x, (m - 1, m - 1))
     windows = jnp.stack(
         [jax.lax.slice_in_dim(padded, j, j + n_out) for j in range(m)])

@@ -11,6 +11,10 @@ parallelism inventory). This package maps those axes onto the TPU fabric:
   ``jax.lax.ppermute``, apply a local windowed op. This is overlap-save
   (convolve.c:178-228) promoted from "blocks within one core" to "shards
   across the mesh" — the framework's context-parallelism story.
+* ``overlap_save`` — ``overlap_save_map``, the two-level long-context
+  combinator: mesh-sharded signal, per-device overlapping FFT blocks
+  processed as one batched kernel (SURVEY §5 long-context plan); plus the
+  distributed overlap-save convolution built on it.
 * ``ops``      — sharded signal ops built on halo_map: convolution,
   decimated and stationary wavelets; plus ``batch_map`` for data-parallel
   batching of any single-signal op.
@@ -19,6 +23,8 @@ parallelism inventory). This package maps those axes onto the TPU fabric:
 from veles.simd_tpu.parallel.mesh import (  # noqa: F401
     default_mesh, make_mesh)
 from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
+from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
+    convolve_overlap_save_sharded, overlap_save_map)
 from veles.simd_tpu.parallel.ops import (  # noqa: F401
     batch_map, convolve_sharded, stationary_wavelet_apply_sharded,
     wavelet_apply_sharded)

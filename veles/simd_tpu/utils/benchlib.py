@@ -36,19 +36,26 @@ def make_chain(step_fn, iters: int):
     return chain
 
 
-def chain_times(steps: dict, carry, iters: int, reps: int = 3) -> dict:
+def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
+                on_floor: str = "raise") -> dict:
     """Per-step seconds for each named step fn, RTT-corrected.
 
     ``steps`` maps name -> (carry -> carry). All configs (plus an implicit
     null chain) are compiled up front, then timed interleaved; returns
-    {name: seconds_per_step}. Raises on non-finite checksums.
+    {name: seconds_per_step}. Raises on non-finite checksums. A config
+    whose total is indistinguishable from the null-chain floor has no
+    meaningful corrected rate: ``on_floor="raise"`` (default) raises,
+    ``on_floor="nan"`` reports NaN for that config and keeps the rest.
     """
     import math
 
+    import jax
     import jax.numpy as jnp
 
-    chains = {"__null__": make_chain(lambda c: c * jnp.float32(1.0000001),
-                                     iters)}
+    chains = {"__null__": make_chain(
+        lambda c: jax.tree_util.tree_map(
+            lambda leaf: leaf * jnp.asarray(1.0000001, leaf.dtype), c),
+        iters)}
     for name, fn in steps.items():
         chains[name] = make_chain(fn, iters)
 
@@ -65,14 +72,19 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3) -> dict:
             best[name] = min(best[name], time.perf_counter() - t0)
 
     floor = best.pop("__null__")
+    out = {}
     for name, total in best.items():
         if total <= floor * 1.05:
-            raise RuntimeError(
-                f"config '{name}' ({total * 1e3:.1f} ms) is indistinguishable "
-                f"from the RTT floor ({floor * 1e3:.1f} ms); raise iters so "
-                f"device time dominates — reporting a corrected rate here "
-                f"would be noise")
-    return {name: (total - floor) / iters for name, total in best.items()}
+            msg = (f"config '{name}' ({total * 1e3:.1f} ms) is "
+                   f"indistinguishable from the RTT floor "
+                   f"({floor * 1e3:.1f} ms); raise iters so device time "
+                   f"dominates — a corrected rate here would be noise")
+            if on_floor == "raise":
+                raise RuntimeError(msg)
+            out[name] = float("nan")
+        else:
+            out[name] = (total - floor) / iters
+    return out
 
 
 def chain_time(step_fn, carry, iters: int, reps: int = 3) -> float:
