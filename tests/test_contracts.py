@@ -1,0 +1,69 @@
+"""Contract-layer tests — the death-test pattern reborn
+(tests/arithmetic.cc:233-313: EXPECT_DEATH on violated contracts becomes
+pytest.raises on ValueError / CheckifyError).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veles.simd_tpu import contracts
+
+
+class TestTraceTime:
+    def test_require_passes_and_raises(self):
+        contracts.require(True, "fine")
+        with pytest.raises(ValueError, match="boom"):
+            contracts.require(False, "boom")
+
+    def test_require_1d(self):
+        contracts.require_1d(np.zeros(4))
+        with pytest.raises(ValueError, match="must be 1-D"):
+            contracts.require_1d(np.zeros((2, 2)), "m")
+
+
+class TestChecked:
+    def test_user_check_raises_on_violation(self):
+        @contracts.checked
+        def rsqrt(x):
+            contracts.check(jnp.all(x > 0), "x must be positive")
+            return 1.0 / jnp.sqrt(x)
+
+        out = rsqrt(jnp.asarray([4.0, 16.0]))
+        np.testing.assert_allclose(np.asarray(out), [0.5, 0.25])
+        with pytest.raises(contracts.CheckifyError, match="positive"):
+            rsqrt(jnp.asarray([4.0, -1.0]))
+
+    def test_float_checks_catch_nan_production(self):
+        @contracts.checked(errors=contracts.FLOAT_CHECKS)
+        def f(x):
+            return jnp.log(x)  # log(-1) -> nan
+
+        f(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(contracts.CheckifyError, match="nan"):
+            f(jnp.asarray([-1.0]))
+
+    def test_ops_contract_example(self):
+        """The reference's length-mismatch assert (matrix.c:257-261
+        analogue) as a value-level check."""
+        @contracts.checked
+        def weighted_sum(x, w):
+            contracts.check(jnp.isfinite(jnp.sum(w)), "weights not finite")
+            return jnp.dot(x, w)
+
+        x = jnp.ones(8)
+        assert float(weighted_sum(x, jnp.ones(8))) == 8.0
+        with pytest.raises(contracts.CheckifyError, match="not finite"):
+            weighted_sum(x, jnp.full(8, jnp.inf) - jnp.full(8, jnp.inf))
+
+
+class TestDebugNans:
+    def test_scoped_toggle(self):
+        import jax
+        before = jax.config.jax_debug_nans
+        with contracts.debug_nans():
+            assert jax.config.jax_debug_nans is True
+            with pytest.raises(FloatingPointError):
+                jnp.log(jnp.asarray(-1.0)) + 1.0
+        assert jax.config.jax_debug_nans == before

@@ -250,3 +250,30 @@ def test_native_abi():
     assert lib.vh_pool_size(h) == -1
     assert not lib.vh_pool_acquire(h, ctypes.byref(ctypes.c_int64(-1)))
     assert lib.vh_pool_destroy(h) == -1  # double destroy
+
+
+class TestReferenceNamedAliases:
+    """memory.h-named entry points (drop-in familiarity layer)."""
+
+    def test_malloc_aligned(self):
+        buf = host.malloc_aligned(256)
+        assert buf.dtype == np.uint8 and buf.size == 256
+        assert buf.ctypes.data % 64 == 0
+
+    def test_malloc_aligned_offset(self):
+        buf = host.malloc_aligned_offset(64, 3)
+        assert buf.ctypes.data % 64 == 3
+
+    def test_mallocf(self):
+        buf = host.mallocf(33)
+        assert buf.dtype == np.float32 and buf.shape == (33,)
+        assert buf.ctypes.data % 64 == 0
+
+    def test_typed_align_complements(self):
+        a = host.aligned_empty(64, np.float32, alignment=32)
+        assert host.align_complement_f32(a) == 0
+        i16 = host.aligned_empty(64, np.int16, alignment=32, offset=8)
+        # 8 bytes past a 32-byte boundary -> 12 int16s to the next one
+        assert host.align_complement_i16(i16) == 12
+        i32 = host.aligned_empty(64, np.int32, alignment=32, offset=8)
+        assert host.align_complement_i32(i32) == 6

@@ -1,0 +1,90 @@
+"""Runtime contracts — the framework's answer to the reference's assert
+layer.
+
+The reference enforces its invariants with dense C ``assert`` contracts and
+``NOTNULL`` attributes (matrix.c:257-261, convolve.c:105-107), and its test
+suite pins them with gtest death tests (tests/arithmetic.cc:233-313). In a
+functional jit world aborting the process is the wrong tool; the analogue
+is three-tiered:
+
+* **trace time** — shape/dtype/argument validation in plain Python before
+  tracing. Every op in veles.simd_tpu.ops already raises ``ValueError`` at
+  this tier; the helpers here (``require``, ``require_1d``) are the shared
+  vocabulary for it.
+* **run time, value-dependent** — ``jax.experimental.checkify``: ``check``
+  inside jitted code records a predicate over traced values, ``checked``
+  functionalizes a whole op so those predicates (plus optional automatic
+  NaN/OOB checks) surface as Python ``CheckifyError`` on the host — the
+  death test reborn as a raised exception (SURVEY §5 race-detection row).
+* **debugging** — ``debug_nans()``: scoped ``jax_debug_nans``, the
+  moral equivalent of running the reference under a checked build.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+from jax.experimental import checkify as _checkify
+
+# re-exported so op code needs only this module
+check = _checkify.check
+CheckifyError = _checkify.JaxRuntimeError
+
+#: error-set presets for ``checked`` (checkify's cost scales with the set)
+USER_CHECKS = _checkify.user_checks
+FLOAT_CHECKS = _checkify.float_checks
+ALL_CHECKS = _checkify.all_checks
+
+
+def require(condition: bool, message: str) -> None:
+    """Trace-time contract: raise ``ValueError`` unless ``condition``.
+
+    For static properties (shapes, dtypes, flags) — evaluated in Python
+    before/independent of tracing, exactly where the reference asserted on
+    lengths and alignment.
+    """
+    if not condition:
+        raise ValueError(message)
+
+
+def require_1d(x, name: str = "array") -> None:
+    """Trace-time contract: ``x`` has exactly one dimension."""
+    require(getattr(x, "ndim", None) == 1,
+            f"{name} must be 1-D, got shape {getattr(x, 'shape', None)}")
+
+
+def checked(fn=None, *, errors=USER_CHECKS):
+    """Wrap a jittable fn so its ``check`` predicates raise on the host.
+
+    ``errors=FLOAT_CHECKS``/``ALL_CHECKS`` additionally instruments every
+    primitive for NaN/inf production (and OOB indexing for ALL) — opt-in
+    because the instrumentation has real cost on TPU. The wrapped function
+    jits the checkified body, so use it at op granularity, not per-call
+    inside hot loops.
+    """
+    if fn is None:
+        return functools.partial(checked, errors=errors)
+
+    checkified = jax.jit(_checkify.checkify(fn, errors=errors))
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = checkified(*args, **kwargs)
+        _checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Scoped ``jax_debug_nans`` — every op in the region re-checks its
+    output for NaNs and raises at the producing primitive."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

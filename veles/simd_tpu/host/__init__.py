@@ -13,7 +13,8 @@ or no toolchain) via NumPy fallbacks with identical semantics — the same
 dual-backend contract the reference's ``simd`` flag provided, and what the
 differential tests in tests/test_host.py exercise.
 
-API parity map (reference -> here):
+API parity map (reference -> here; the reference names also exist as
+thin aliases for drop-in familiarity):
   malloc_aligned / mallocf        -> aligned_empty
   malloc_aligned_offset           -> aligned_empty(..., offset=)
   align_complement_{f32,i16,i32}  -> align_complement
@@ -36,6 +37,9 @@ __all__ = [
     "native_available", "aligned_empty", "align_complement", "memsetf",
     "rmemcpyf", "crmemcpyf", "zeropadding", "zeropaddingex", "convert",
     "StagingPool", "to_device",
+    # reference-named aliases (memory.h parity)
+    "malloc_aligned", "malloc_aligned_offset", "mallocf",
+    "align_complement_f32", "align_complement_i16", "align_complement_i32",
 ]
 
 _CONVERSIONS = {
@@ -179,6 +183,38 @@ def zeropaddingex(src: np.ndarray, additional_length: int) -> np.ndarray:
     else:
         lib.vh_zeropad_f32(_ptr(out), _ptr(src), src.size, out.size)
     return out
+
+
+def malloc_aligned(size: int) -> np.ndarray:
+    """Reference-named alias: ``size``-byte 64-byte-aligned buffer
+    (memory.c:69-79). Returns a uint8 ndarray; ``.view(dtype)`` it."""
+    return aligned_empty(size, np.uint8)
+
+
+def malloc_aligned_offset(size: int, offset: int) -> np.ndarray:
+    """Reference-named alias: buffer whose data starts ``offset`` bytes past
+    a 64-byte boundary (memory.c:63-67)."""
+    return aligned_empty(size, np.uint8, offset=offset)
+
+
+def mallocf(length: int) -> np.ndarray:
+    """Reference-named alias: ``length`` aligned float32s (memory.c:81-83)."""
+    return aligned_empty(length, np.float32)
+
+
+def align_complement_f32(a: np.ndarray) -> int:
+    """float32 elements to the next 32-byte boundary (memory.c:41-47)."""
+    return align_complement(a, 32)
+
+
+def align_complement_i16(a: np.ndarray) -> int:
+    """int16 elements to the next 32-byte boundary (memory.c:49-54)."""
+    return align_complement(a, 32)
+
+
+def align_complement_i32(a: np.ndarray) -> int:
+    """int32 elements to the next 32-byte boundary (memory.c:56-61)."""
+    return align_complement(a, 32)
 
 
 def convert(src: np.ndarray, to_dtype) -> np.ndarray:
