@@ -6,7 +6,6 @@ construction contracts on CPU with tiny shapes.
 
 import math
 
-import numpy as np
 import pytest
 
 import jax.numpy as jnp

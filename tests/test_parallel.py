@@ -8,10 +8,8 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from veles.simd_tpu import ops, parallel
-from veles.simd_tpu.reference import wavelet as ref_wavelet
 
 
 @pytest.fixture(scope="module")

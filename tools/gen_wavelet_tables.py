@@ -35,7 +35,6 @@ Run:  python tools/gen_wavelet_tables.py [--validate-against /root/reference]
 import argparse
 import os
 import re
-import sys
 
 import numpy as np
 from mpmath import mp, mpf, binomial, sqrt as mpsqrt, polyroots
@@ -87,7 +86,8 @@ def _roots_and_groups(p):
         else:
             # find conjugate partner
             for j in range(i + 1, len(yroots)):
-                if not used[j] and abs(yroots[j] - mp.conj(y)) < abs(y) * mp.mpf(10) ** (-mp.dps // 2):
+                tol = abs(y) * mp.mpf(10) ** (-mp.dps // 2)
+                if not used[j] and abs(yroots[j] - mp.conj(y)) < tol:
                     used[j] = True
                     groups.append(
                         ([pairs[i][0], pairs[j][0]], [pairs[i][1], pairs[j][1]])

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Dependency-free Python lint — the cpplint layer reborn
+(/root/reference/cpplint.py via fullcheck_xml.sh:3).
+
+The reference ships Google's cpplint and a converter to cppcheck XML so CI
+can gate style. This environment has no flake8/pyflakes/ruff, so the same
+role is filled with a small AST + text linter over the repo's own rules:
+
+  T1  tab in indentation              (style, like cpplint whitespace/tab)
+  T2  trailing whitespace
+  T3  line longer than 100 columns
+  A1  unused import                   (pyflakes F401 equivalent;
+                                       ``# noqa`` on the line suppresses)
+  A2  bare ``except:``
+  A3  mutable default argument (list/dict/set literal)
+  A4  f-string with no placeholders
+  S1  syntax error
+
+Usage:  python tools/lint.py [paths...]     (default: the whole repo)
+        --xml  emit cppcheck-style XML (fullcheck_xml analogue)
+Exit status 1 if any finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LINE = 100
+DEFAULT_GLOBS = ["veles/**/*.py", "tests/*.py", "tools/*.py", "bench.py",
+                 "__graft_entry__.py"]
+
+
+def _noqa(lines, lineno):
+    return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collects imported bindings and every name/attribute-root usage."""
+
+    def __init__(self):
+        self.imports = {}   # name -> (lineno, display)
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # compiler directives, used by definition
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = (node.lineno,
+                                  f"{node.module or ''}.{alias.name}")
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_file(path):
+    findings = []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        indent = stripped[:len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            findings.append((i, "T1", "tab in indentation"))
+        if stripped != stripped.rstrip():
+            findings.append((i, "T2", "trailing whitespace"))
+        if len(stripped) > MAX_LINE and not _noqa(lines, i):
+            findings.append((i, "T3",
+                             f"line too long ({len(stripped)} > {MAX_LINE})"))
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append((e.lineno or 0, "S1", f"syntax error: {e.msg}"))
+        return findings
+
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    # names exported via __all__ or re-exported in package __init__ count
+    exported = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)):
+            try:
+                exported |= set(ast.literal_eval(node.value))
+            except ValueError:
+                pass
+    for name, (lineno, display) in tracker.imports.items():
+        if name in tracker.used or name in exported or name == "_":
+            continue
+        if _noqa(lines, lineno):
+            continue
+        findings.append((lineno, "A1", f"unused import '{display}'"))
+
+    # format specs (":>8" etc.) parse as nested JoinedStr nodes with no
+    # placeholders of their own — not user f-strings, skip them in A4
+    spec_ids = {id(n.format_spec) for n in ast.walk(tree)
+                if isinstance(n, ast.FormattedValue) and n.format_spec}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not _noqa(lines, node.lineno):
+                findings.append((node.lineno, "A2", "bare 'except:'"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append((node.lineno, "A3",
+                                     "mutable default argument in "
+                                     f"'{node.name}'"))
+        elif isinstance(node, ast.JoinedStr):
+            if (id(node) not in spec_ids
+                    and not any(isinstance(v, ast.FormattedValue)
+                                for v in node.values)
+                    and not _noqa(lines, node.lineno)):
+                findings.append((node.lineno, "A4",
+                                 "f-string without placeholders"))
+    return findings
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="*")
+    ap.add_argument("--xml", action="store_true",
+                    help="cppcheck-style XML on stdout")
+    args = ap.parse_args()
+
+    if args.paths:
+        files = []
+        for p in args.paths:
+            files.extend(glob.glob(p, recursive=True) if "*" in p else [p])
+    else:
+        files = []
+        for pattern in DEFAULT_GLOBS:
+            files.extend(glob.glob(os.path.join(REPO, pattern),
+                                   recursive=True))
+
+    total = 0
+    xml_rows = []
+    for path in sorted(set(files)):
+        for lineno, code, msg in sorted(lint_file(path)):
+            total += 1
+            rel = os.path.relpath(path, REPO)
+            if args.xml:
+                xml_rows.append(
+                    f'  <error file="{rel}" line="{lineno}" id="{code}" '
+                    f'severity="style" msg="{msg}"/>')
+            else:
+                print(f"{rel}:{lineno}: [{code}] {msg}")
+
+    if args.xml:
+        print('<?xml version="1.0"?>\n<results>')
+        print("\n".join(xml_rows))
+        print("</results>")
+    if total:
+        print(f"{total} finding(s)", file=sys.stderr)
+        sys.exit(1)
+    print("lint clean", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Suite-by-suite test runner — the Tests.make harness reborn
+(/root/reference/tests/Tests.make:60-96).
+
+The reference runs each gtest binary under ``timeout`` and ``/usr/bin/time``
+(peak RSS), writes per-suite XML, aggregates everything into ``tests.log``,
+and prints a green/red summary. Here each ``tests/test_*.py`` file is one
+suite (one binary per module, tests/Makefile.am:26-27), run as its own
+pytest process with:
+
+* a per-suite wall-clock timeout (default 600 s — first XLA compiles are
+  slow; the reference used 60 s for native binaries),
+* peak-RSS measurement via ``resource.getrusage(RUSAGE_CHILDREN)``,
+* per-suite JUnit XML under ``test-results/`` (--gtest_output analogue),
+* an aggregated ``tests.log`` and a colored pass/fail table.
+
+Exit status is non-zero if any suite fails — same contract the reference's
+``make tests`` target had.
+
+Usage:  python tools/run_tests.py [suite ...] [--timeout S] [--jobs N]
+        (suites by bare name: "wavelet" -> tests/test_wavelet.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GREEN, RED, DIM, RESET = "\033[32m", "\033[31m", "\033[2m", "\033[0m"
+
+
+def discover(names):
+    paths = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    if not names:
+        return paths
+    by_name = {os.path.basename(p)[5:-3]: p for p in paths}
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        sys.exit(f"unknown suite(s): {missing}; have {sorted(by_name)}")
+    return [by_name[n] for n in names]
+
+
+def run_suite(path, timeout, xml_dir):
+    name = os.path.basename(path)[5:-3]
+    xml = os.path.join(xml_dir, f"{name}.xml")
+    before = resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", path, "-q",
+             f"--junitxml={xml}"],
+            cwd=REPO, timeout=timeout, capture_output=True, text=True)
+        status = "pass" if proc.returncode == 0 else "FAIL"
+        output = proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as e:
+        status = "TIMEOUT"
+        output = ((e.stdout or b"").decode(errors="replace") +
+                  (e.stderr or b"").decode(errors="replace"))
+    wall = time.perf_counter() - t0
+    after = resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss
+    # ru_maxrss is a high-water mark over all children, so per-suite
+    # attribution is only exact for the suite that sets a new peak —
+    # the same granularity /usr/bin/time gave the reference per binary.
+    peak_kb = max(after, before)
+    return {"name": name, "status": status, "wall_s": wall,
+            "peak_kb": peak_kb, "output": output}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("suites", nargs="*",
+                    help="bare suite names (default: all)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-suite wall-clock limit in seconds")
+    ap.add_argument("--log", default=os.path.join(REPO, "tests.log"))
+    ap.add_argument("--xml-dir",
+                    default=os.path.join(REPO, "test-results"))
+    args = ap.parse_args()
+
+    os.makedirs(args.xml_dir, exist_ok=True)
+    color = sys.stdout.isatty()
+
+    def paint(tint, text):
+        return f"{tint}{text}{RESET}" if color else text
+
+    results = []
+    with open(args.log, "w") as log:
+        for path in discover(args.suites):
+            res = run_suite(path, args.timeout, args.xml_dir)
+            results.append(res)
+            log.write(f"==== {res['name']}: {res['status']} "
+                      f"({res['wall_s']:.1f}s, peak memory: "
+                      f"{res['peak_kb']} Kb) ====\n")
+            log.write(res["output"] + "\n")
+            ok = res["status"] == "pass"
+            line = (f"{res['name']:<20} {res['status']:<8} "
+                    f"{res['wall_s']:>7.1f}s  peak {res['peak_kb']:>8} Kb")
+            print(paint(GREEN if ok else RED, line))
+
+    failed = [r for r in results if r["status"] != "pass"]
+    total = sum(r["wall_s"] for r in results)
+    print(paint(DIM, f"{len(results)} suites, {total:.0f}s total; "
+                     f"log: {os.path.relpath(args.log, REPO)}"))
+    if failed:
+        print(paint(RED, f"FAILED: {', '.join(r['name'] for r in failed)}"))
+        sys.exit(1)
+    print(paint(GREEN, "ALL SUITES PASSED"))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,8 +13,6 @@ accepted everywhere (the per-plane reduction runs over the trailing 2 axes).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 

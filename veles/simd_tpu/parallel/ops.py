@@ -7,8 +7,6 @@ implication — the sharded path is "the other backend" to test against).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
