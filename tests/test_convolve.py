@@ -1,4 +1,5 @@
-"""Convolution/correlation tests (tests/convolve.cc + correlate.cc patterns).
+"""Convolution tests (tests/convolve.cc patterns; correlation has its own
+suite in tests/test_correlate.py).
 
 Golden vectors from the reference tests; differential sweeps over the same
 size grid the reference benchmarks (x in {32..2000}, h in {50..950}) with
@@ -13,20 +14,12 @@ from veles.simd_tpu import ops
 GOLDEN_X = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype=np.float32)
 GOLDEN_H = np.array([10, 9, 8, 7], dtype=np.float32)
 GOLDEN_CONV = [10, 29, 56, 90, 124, 158, 192, 226, 170, 113, 56]
-GOLDEN_CORR = [7, 22, 46, 80, 114, 148, 182, 216, 187, 142, 80]
 
 
 @pytest.mark.parametrize("algorithm", ["direct", "fft"])
 def test_convolve_golden(algorithm):
     got = np.asarray(ops.convolve(GOLDEN_X, GOLDEN_H, algorithm=algorithm))
     np.testing.assert_allclose(got, GOLDEN_CONV, atol=1e-3)
-
-
-@pytest.mark.parametrize("algorithm", ["direct", "fft"])
-def test_correlate_golden(algorithm):
-    got = np.asarray(ops.cross_correlate(GOLDEN_X, GOLDEN_H,
-                                         algorithm=algorithm))
-    np.testing.assert_allclose(got, GOLDEN_CORR, atol=1e-3)
 
 
 # The reference's benchmark grid (tests/convolve.cc:171-400), trimmed to the
@@ -45,18 +38,6 @@ def test_convolve_differential(x_len, h_len, algorithm, rng):
     ref = ops.convolve(x, h, impl="reference")
     got = np.asarray(ops.convolve(x, h, algorithm=algorithm))
     assert got.shape == (x_len + h_len - 1,)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
-
-
-@pytest.mark.parametrize("x_len,h_len", SIZES)
-@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
-def test_correlate_differential(x_len, h_len, algorithm, rng):
-    if algorithm == "overlap_save" and h_len >= x_len / 2:
-        pytest.skip("overlap_save precondition")
-    x = rng.normal(size=x_len).astype(np.float32)
-    h = rng.normal(size=h_len).astype(np.float32)
-    ref = ops.cross_correlate(x, h, impl="reference")
-    got = np.asarray(ops.cross_correlate(x, h, algorithm=algorithm))
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
 
 
@@ -110,11 +91,6 @@ def test_handle_api(rng):
     ops.convolve_finalize(handle)  # no-op, parity
     with pytest.raises(ValueError):
         handle(x[:100], h)
-    corr_handle = ops.cross_correlate_initialize(1020, 50, algorithm="fft")
-    assert corr_handle.reverse
-    np.testing.assert_allclose(np.asarray(corr_handle(x, h)),
-                               ops.cross_correlate(x, h, impl="reference"),
-                               rtol=2e-4, atol=2e-3)
 
 
 def test_overlap_save_precondition():

@@ -1,0 +1,93 @@
+"""Cross-correlation suite (tests/correlate.cc patterns).
+
+Mirrors the reference's dedicated correlate suite: golden vectors
+(correlate.cc:53-71), differential sweeps against the float64 oracle, the
+handle API, and the reversed-convolution delegation identity
+(correlate.c:128-142).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+GOLDEN_X = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype=np.float32)
+GOLDEN_H = np.array([10, 9, 8, 7], dtype=np.float32)
+GOLDEN_CORR = [7, 22, 46, 80, 114, 148, 182, 216, 187, 142, 80]
+
+SIZES = [(32, 5), (50, 12), (200, 50), (350, 127), (1020, 50), (2000, 512),
+         (2000, 950), (333, 77)]
+
+
+@pytest.mark.parametrize("algorithm", ["direct", "fft"])
+def test_correlate_golden(algorithm):
+    got = np.asarray(ops.cross_correlate(GOLDEN_X, GOLDEN_H,
+                                         algorithm=algorithm))
+    np.testing.assert_allclose(got, GOLDEN_CORR, atol=1e-3)
+
+
+@pytest.mark.parametrize("x_len,h_len", SIZES)
+@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
+def test_correlate_differential(x_len, h_len, algorithm, rng):
+    if algorithm == "overlap_save" and h_len >= x_len / 2:
+        pytest.skip("overlap_save precondition")
+    x = rng.normal(size=x_len).astype(np.float32)
+    h = rng.normal(size=h_len).astype(np.float32)
+    ref = ops.cross_correlate(x, h, impl="reference")
+    got = np.asarray(ops.cross_correlate(x, h, algorithm=algorithm))
+    assert got.shape == (x_len + h_len - 1,)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
+
+
+def test_matches_numpy_correlate_full(rng):
+    x = rng.normal(size=200).astype(np.float32)
+    h = rng.normal(size=31).astype(np.float32)
+    want = np.correlate(h.astype(np.float64), x.astype(np.float64),
+                        mode="full")[::-1]
+    got = np.asarray(ops.cross_correlate(x, h))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+def test_is_reversed_convolution(rng):
+    """The delegation identity the whole module is built on
+    (correlate.c:37-72): corr(x, h) == conv(x, reverse(h))."""
+    x = rng.normal(size=300).astype(np.float32)
+    h = rng.normal(size=40).astype(np.float32)
+    via_conv = np.asarray(ops.convolve(x, h[::-1].copy(), algorithm="fft"))
+    got = np.asarray(ops.cross_correlate(x, h, algorithm="fft"))
+    np.testing.assert_allclose(got, via_conv, atol=1e-3)
+
+
+def test_named_algorithm_wrappers(rng):
+    x = rng.normal(size=400).astype(np.float32)
+    h = rng.normal(size=25).astype(np.float32)
+    ref = ops.cross_correlate(x, h, impl="reference")
+    for fn in (ops.cross_correlate_simd, ops.cross_correlate_fft):
+        np.testing.assert_allclose(np.asarray(fn(x, h)), ref,
+                                   rtol=2e-4, atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(ops.cross_correlate_overlap_save(
+            np.tile(x, 64), h)),
+        ops.cross_correlate(np.tile(x, 64), h, impl="reference"),
+        rtol=5e-4, atol=5e-3)
+
+
+def test_handle_api(rng):
+    x = rng.normal(size=1020).astype(np.float32)
+    h = rng.normal(size=50).astype(np.float32)
+    handle = ops.cross_correlate_initialize(1020, 50, algorithm="fft")
+    assert handle.reverse
+    np.testing.assert_allclose(np.asarray(handle(x, h)),
+                               ops.cross_correlate(x, h, impl="reference"),
+                               rtol=2e-4, atol=2e-3)
+    ops.cross_correlate_finalize(handle)  # no-op, parity
+    with pytest.raises(ValueError):
+        handle(x[:100], h)
+
+
+def test_autocorrelation_peaks_at_zero_lag(rng):
+    x = rng.normal(size=256).astype(np.float32)
+    r = np.asarray(ops.cross_correlate(x, x))
+    assert r.shape == (511,)
+    assert np.argmax(r) == 255  # zero lag sits at index x_len-1
+    np.testing.assert_allclose(r[255], float(np.dot(x, x)), rtol=1e-4)

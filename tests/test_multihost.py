@@ -1,0 +1,38 @@
+"""Multi-host layer tests — single-process behavior only (no pod here);
+the hybrid mesh must collapse transparently so specs written against it
+run unchanged on real DCN topologies."""
+
+import pytest
+
+from veles.simd_tpu.parallel import multihost
+
+
+def test_process_info_single_process():
+    assert multihost.process_info() == (0, 1)
+
+
+def test_hybrid_mesh_collapses_single_host():
+    mesh = multihost.hybrid_mesh({"data": 2}, {"seq": 4})
+    assert mesh.axis_names == ("data", "seq")
+    assert mesh.shape == {"data": 2, "seq": 4}
+
+
+def test_hybrid_mesh_axis_order_is_dcn_outer():
+    mesh = multihost.hybrid_mesh({"dp": 1}, {"seq": 8})
+    assert mesh.axis_names == ("dp", "seq")
+    assert mesh.devices.shape == (1, 8)
+
+
+def test_overlapping_axis_names_rejected():
+    with pytest.raises(ValueError, match="both"):
+        multihost.hybrid_mesh({"seq": 2}, {"seq": 4})
+
+
+def test_initialize_noop_without_coordinator():
+    multihost.initialize()  # must not raise in single-process mode
+
+
+def test_initialize_raises_with_bad_explicit_coordinator():
+    with pytest.raises(Exception):
+        multihost.initialize("256.0.0.1:1", num_processes=2, process_id=0,
+                             initialization_timeout=1)

@@ -1,0 +1,73 @@
+"""Observability-layer tests: FLOP models against first principles, trace
+capture smoke (SURVEY §5 tracing plan)."""
+
+import os
+
+import pytest
+
+import jax.numpy as jnp
+
+from veles.simd_tpu.utils import profiling as P
+
+
+class TestFlopModels:
+    def test_matmul(self):
+        assert P.matmul_flops(4096, 4096, 4096) == 2 * 4096 ** 3
+
+    def test_direct_conv_counts_every_output_dot(self):
+        # n+m-1 outputs, m macs each
+        assert P.convolve_direct_flops(8, 4) == 2 * 4 * 11
+
+    def test_overlap_save_scales_with_blocks(self):
+        import math
+        step = 8192 - 126
+        few = P.convolve_overlap_save_flops(8192, 127, 8192)
+        many = P.convolve_overlap_save_flops(65536, 127, 8192)
+        h_fft = P.fft_flops(8192)
+        ratio = (many - h_fft) / (few - h_fft)  # = n_blocks ratio exactly
+        assert ratio == pytest.approx(
+            math.ceil(65536 / step) / math.ceil(8192 / step))
+
+    def test_wavelet_dwt_halves_per_level(self):
+        n, order = 1024, 8
+        l1 = P.wavelet_flops(n, order, levels=1)
+        l2 = P.wavelet_flops(n, order, levels=2)
+        assert l1 == 2 * 2 * order * (n // 2)
+        assert l2 == l1 + 2 * 2 * order * (n // 4)
+
+    def test_swt_full_length_every_level(self):
+        n, order = 1024, 8
+        assert (P.wavelet_flops(n, order, stationary=True, levels=3)
+                == 3 * 2 * 2 * order * n)
+
+
+class TestUtilization:
+    def test_north_star_arithmetic(self):
+        # BASELINE: 98.5 TFLOPS on v5e == exactly 50% MXU utilization
+        fl = P.matmul_flops(4096, 4096, 4096)
+        secs = fl / 98.5e12
+        assert P.mxu_utilization(fl, secs) == pytest.approx(0.5)
+
+    def test_hbm_bound_elementwise(self):
+        # 1M-float add reads 2 streams, writes 1 at the full 819 GB/s
+        n = 1 << 20
+        num_bytes = 3 * 4 * n
+        secs = num_bytes / 819e9
+        assert P.hbm_utilization(num_bytes, secs) == pytest.approx(1.0)
+
+    def test_unknown_chip_raises(self):
+        with pytest.raises(KeyError):
+            P.mxu_utilization(1e9, 1.0, chip="v99")
+
+
+class TestTrace:
+    def test_capture_writes_trace_dir(self, tmp_path):
+        d = str(tmp_path / "trace")
+        with P.trace(d):
+            with P.annotate("veles-test-region"):
+                jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))
+                        ).block_until_ready()
+        found = []
+        for root, _dirs, files in os.walk(d):
+            found.extend(files)
+        assert found, "profiler produced no trace files"

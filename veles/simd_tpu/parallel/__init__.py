@@ -22,6 +22,8 @@ parallelism inventory). This package maps those axes onto the TPU fabric:
 
 from veles.simd_tpu.parallel.mesh import (  # noqa: F401
     default_mesh, make_mesh)
+from veles.simd_tpu.parallel.multihost import (  # noqa: F401
+    hybrid_mesh, process_info)
 from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
 from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
     convolve_overlap_save_sharded, overlap_save_map)

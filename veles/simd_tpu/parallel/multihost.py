@@ -1,0 +1,76 @@
+"""Multi-host (DCN) scaling — the distributed backend the reference never
+had (SURVEY §2: no MPI/NCCL/sockets anywhere; §5 plan: JAX collectives
+over ICI within a slice, DCN across hosts, one ``Mesh`` either way).
+
+On a multi-host TPU pod each process sees its local chips;
+``initialize()`` wires the JAX distributed runtime (coordinator +
+process_id from the scheduler environment, or explicit arguments) and
+``hybrid_mesh`` builds a mesh whose outer axes ride the slow DCN links and
+inner axes the fast ICI — so data parallelism crosses hosts while
+sequence/tensor axes stay inside a slice. Single-process runs (this box,
+CI's virtual CPU devices) fall back to a plain mesh transparently, which
+is what keeps this module testable without a pod.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from veles.simd_tpu.parallel.mesh import make_mesh
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kwargs) -> None:
+    """Bring up the JAX distributed runtime (idempotent, no-op for
+    single-process runs with no coordinator configured).
+
+    With no arguments, defers to jax.distributed's environment
+    auto-detection (TPU pod metadata / cluster env vars). Call once,
+    before any jax computation, on every host.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id, **kwargs)
+    except (ValueError, RuntimeError):
+        # no coordinator configured / already initialized: single-process
+        if coordinator_address is not None:
+            raise
+
+
+def process_info() -> tuple:
+    """(process_index, process_count) — (0, 1) off-pod."""
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def hybrid_mesh(dcn_axes: dict, ici_axes: dict, *, devices=None) -> Mesh:
+    """Mesh with ``dcn_axes`` (outer, cross-host) x ``ici_axes`` (inner,
+    within-slice). E.g. ``hybrid_mesh({"data": 4}, {"seq": 8})`` on a
+    4-host v5e-32: batch sharded across hosts over DCN, sequence halos
+    ride ICI only — the layout SURVEY §5 prescribes for long signals.
+
+    Single-host (process_count == 1): collapses to a plain make_mesh over
+    the combined axes, preserving axis names and order so sharding specs
+    written against it work unchanged on a pod.
+    """
+    import jax
+    import numpy as np
+
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} appear in both dcn_axes "
+                         "and ici_axes")
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+
+    if jax.process_count() == 1:
+        return make_mesh(dict(zip(names, sizes)), devices=devices)
+
+    from jax.experimental import mesh_utils
+    dev_mesh = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_axes.values()), tuple(dcn_axes.values()),
+        devices=devices)
+    # create_hybrid_device_mesh returns (dcn..., ici...) — matches names
+    return Mesh(np.asarray(dev_mesh), names)

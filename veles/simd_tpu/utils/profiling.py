@@ -1,0 +1,119 @@
+"""Tracing & observability — the subsystem the reference lacks
+(SURVEY §5: its only instrumentation is chrono timing in benchmark.inc and
+/usr/bin/time peak-RSS per suite).
+
+Three pieces:
+
+* ``trace`` / ``annotate`` — scoped ``jax.profiler`` capture producing a
+  TensorBoard/Perfetto trace directory, with named regions.
+* FLOP accounting — closed-form per-op work models (matmul, conv by
+  algorithm, FFT, DWT/SWT filter banks) so harnesses report achieved
+  GFLOPS without hardware counters.
+* ``mxu_utilization`` / ``hbm_utilization`` — achieved/peak ratios against
+  per-generation ceilings; the BASELINE north star ("matrix_multiply
+  N=4096 at >= 50% MXU utilization") is ``mxu_utilization(...) >= 0.5``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+
+#: per-chip ceilings by TPU generation: (bf16 matmul FLOP/s, HBM B/s)
+CHIP_PEAKS = {
+    "v5e": (197e12, 819e9),
+    "v4": (275e12, 1228e9),
+    "v5p": (459e12, 2765e9),
+    "v6e": (918e12, 1640e9),
+}
+DEFAULT_CHIP = "v5e"
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Scoped profiler capture: ``with trace("/tmp/trace"): run()`` then
+    point TensorBoard (or xprof) at ``log_dir``."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a capture (shows as a track span)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+# ---------------------------------------------------------------------------
+# FLOP models (multiply+add counted as 2)
+# ---------------------------------------------------------------------------
+
+def matmul_flops(m: int, k: int, n: int) -> int:
+    """C[m,n] = A[m,k] @ B[k,n]."""
+    return 2 * m * k * n
+
+
+def fft_flops(n: int, batch: int = 1) -> float:
+    """Real-input FFT cost model: ~2.5 * n * log2(n) per transform."""
+    return batch * 2.5 * n * math.log2(max(n, 2))
+
+
+def convolve_direct_flops(x_len: int, h_len: int) -> int:
+    """Brute-force linear convolution: one length-h dot per output."""
+    return 2 * h_len * (x_len + h_len - 1)
+
+
+def convolve_fft_flops(x_len: int, h_len: int, fft_length: int) -> float:
+    """Full-FFT convolution: 2 forward + 1 inverse + pointwise complex
+    multiply (6 flops per complex bin) + 1/M scale."""
+    return (3 * fft_flops(fft_length)
+            + 6 * (fft_length // 2 + 1) + fft_length)
+
+
+def convolve_overlap_save_flops(x_len: int, h_len: int,
+                                block: int) -> float:
+    """Per-block fwd+inv FFT + complex multiply, over ceil(x/step)
+    blocks (convolve.c:181-228 structure)."""
+    step = block - (h_len - 1)
+    n_blocks = math.ceil(x_len / step)
+    per_block = 2 * fft_flops(block) + 6 * (block // 2 + 1) + block
+    return fft_flops(block) + n_blocks * per_block  # + one H transform
+
+
+def wavelet_flops(n: int, order: int, *, stationary: bool = False,
+                  levels: int = 1) -> int:
+    """DWT: hi+lo filter bank, n/2 outputs each per level, halving n;
+    SWT: full-length outputs every level."""
+    total, length = 0, n
+    for _ in range(levels):
+        outputs = length if stationary else length // 2
+        total += 2 * 2 * order * outputs  # two bands, 2*order flops each
+        if not stationary:
+            length //= 2
+    return total
+
+
+# ---------------------------------------------------------------------------
+# utilization
+# ---------------------------------------------------------------------------
+
+def achieved_gflops(flops: float, seconds: float) -> float:
+    return flops / seconds / 1e9
+
+
+def mxu_utilization(flops: float, seconds: float,
+                    chip: str = DEFAULT_CHIP) -> float:
+    """Fraction of the chip's bf16 matmul peak actually achieved."""
+    peak, _ = CHIP_PEAKS[chip]
+    return flops / seconds / peak
+
+
+def hbm_utilization(num_bytes: float, seconds: float,
+                    chip: str = DEFAULT_CHIP) -> float:
+    """Fraction of HBM bandwidth achieved — the ceiling that matters for
+    elementwise/normalize/peak-detect configs (they stream, not crunch)."""
+    _, peak = CHIP_PEAKS[chip]
+    return num_bytes / seconds / peak
