@@ -28,6 +28,31 @@ def test_overlapping_axis_names_rejected():
         multihost.hybrid_mesh({"seq": 2}, {"seq": 4})
 
 
+def test_hybrid_mesh_multiprocess_padded_shapes(monkeypatch):
+    """The pod path must hand create_hybrid_device_mesh SAME-RANK ici/dcn
+    shapes whose elementwise product is (dcn..., ici...) — jax np.block-
+    assembles the product, it does not concatenate dims."""
+    import numpy as np
+    import jax
+
+    captured = {}
+
+    def fake_chdm(mesh_shape, dcn_mesh_shape, devices=None):
+        assert len(mesh_shape) == len(dcn_mesh_shape)
+        shape = tuple(np.multiply(mesh_shape, dcn_mesh_shape))
+        return np.array(jax.devices()[: int(np.prod(shape))],
+                        dtype=object).reshape(shape)
+
+    from jax.experimental import mesh_utils
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_chdm)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    mesh = multihost.hybrid_mesh({"data": 2}, {"seq": 4})
+    assert mesh.axis_names == ("data", "seq")
+    assert mesh.devices.shape == (2, 4)
+    captured  # silence lint
+
+
 def test_initialize_noop_without_coordinator():
     multihost.initialize()  # must not raise in single-process mode
 

@@ -29,12 +29,25 @@ def initialize(coordinator_address=None, num_processes=None,
     before any jax computation, on every host.
     """
     import jax
+    from jax._src import distributed as _dist
+    from jax._src import xla_bridge as _bridge
 
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # distributed runtime already up — idempotent
+    if coordinator_address is None and _bridge.backends_are_initialized():
+        # Too late to bring up a cluster and none was requested: the
+        # intended single-process fallback (this box, CI).
+        return
     try:
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id, **kwargs)
-    except (ValueError, RuntimeError):
-        # no coordinator configured / already initialized: single-process
+    except ValueError:
+        # With no arguments, jax raises ValueError iff environment
+        # auto-detection found no cluster at all — the intended
+        # single-process fallback. Anything else (a configured cluster
+        # that failed to come up, RuntimeError from the coordinator)
+        # must propagate: swallowing it would leave process_count()==1
+        # and every host silently computing the full problem alone.
         if coordinator_address is not None:
             raise
 
@@ -69,8 +82,13 @@ def hybrid_mesh(dcn_axes: dict, ici_axes: dict, *, devices=None) -> Mesh:
         return make_mesh(dict(zip(names, sizes)), devices=devices)
 
     from jax.experimental import mesh_utils
+    # create_hybrid_device_mesh takes same-rank ICI and DCN shapes and
+    # returns their ELEMENTWISE product shape, so to get distinct
+    # (dcn..., ici...) mesh dims each side pads the other's axes with 1s:
+    # ici shape (1,..,1, i1,..,ik), dcn shape (d1,..,dm, 1,..,1)
+    # -> result shape (d1,..,dm, i1,..,ik), matching ``names``.
+    ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
     dev_mesh = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_axes.values()), tuple(dcn_axes.values()),
-        devices=devices)
-    # create_hybrid_device_mesh returns (dcn..., ici...) — matches names
+        ici_shape, dcn_shape, devices=devices)
     return Mesh(np.asarray(dev_mesh), names)

@@ -31,13 +31,22 @@ def bench_elementwise(scale=1):
     x = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
 
     def step(c):
-        # add / mul / scale fused round-trip (tests/arithmetic.cc kernels)
-        return (c + c) * c * jnp.float32(0.5)
+        # add / mul / scale round-trip (tests/arithmetic.cc kernels).
+        # Affine with fixed point 1.0 so the chain stays finite (a
+        # self-multiply chain squares the carry and overflows).
+        return (c + c) * jnp.float32(0.25) + jnp.float32(0.5)
 
-    dt = chain_time(step, x, iters=2048)
+    # The null chain must NOT stream the same array (that would cancel
+    # the pass being measured), so the RTT floor runs on an 8-element
+    # carry. Measured effective bandwidth comes out well above HBM peak:
+    # XLA keeps the 4 MB loop carry VMEM-resident across scan steps, so
+    # this is on-chip VPU elementwise throughput (the right analogue of
+    # the reference's in-cache arithmetic-inl.h kernels).
+    dt = chain_time(step, x, iters=8192, null_carry=x[:8])
+    gbps = n * 8 / dt / 1e9  # read + write, 4 B each
     return {"metric": f"elementwise_add_mul_scale_n{n}",
             "value": round(n * 3 / dt / 1e9, 2), "unit": "Gop/s",
-            "vs_baseline": None}
+            "vs_baseline": None, "effective_gbps": round(gbps, 1)}
 
 
 def bench_convolve(scale=1):

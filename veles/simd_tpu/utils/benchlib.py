@@ -37,7 +37,7 @@ def make_chain(step_fn, iters: int):
 
 
 def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
-                on_floor: str = "raise") -> dict:
+                on_floor: str = "raise", null_carry=None) -> dict:
     """Per-step seconds for each named step fn, RTT-corrected.
 
     ``steps`` maps name -> (carry -> carry). All configs (plus an implicit
@@ -46,6 +46,13 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
     whose total is indistinguishable from the null-chain floor has no
     meaningful corrected rate: ``on_floor="raise"`` (default) raises,
     ``on_floor="nan"`` reports NaN for that config and keeps the rest.
+
+    The null chain runs over ``carry`` by default, which also cancels one
+    HBM stream pass over it per step — right for measuring compute on top
+    of traffic, wrong for measuring the traffic itself. For streaming
+    (HBM-bound) configs pass a tiny ``null_carry`` so the floor captures
+    only dispatch/scan/RTT overhead and the corrected time keeps the
+    memory traffic.
     """
     import math
 
@@ -58,9 +65,12 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
         iters)}
     for name, fn in steps.items():
         chains[name] = make_chain(fn, iters)
+    carries = {name: carry for name in chains}
+    if null_carry is not None:
+        carries["__null__"] = null_carry
 
     for name, chain in chains.items():
-        value = float(chain(carry))  # compile + warm
+        value = float(chain(carries[name]))  # compile + warm
         if not math.isfinite(value):
             raise RuntimeError(f"non-finite checksum from {name}: {value}")
 
@@ -68,7 +78,7 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
     for _ in range(reps):
         for name, chain in chains.items():
             t0 = time.perf_counter()
-            float(chain(carry))
+            float(chain(carries[name]))
             best[name] = min(best[name], time.perf_counter() - t0)
 
     floor = best.pop("__null__")
@@ -87,6 +97,8 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
     return out
 
 
-def chain_time(step_fn, carry, iters: int, reps: int = 3) -> float:
+def chain_time(step_fn, carry, iters: int, reps: int = 3, *,
+               null_carry=None) -> float:
     """Single-config convenience wrapper over chain_times."""
-    return chain_times({"_": step_fn}, carry, iters, reps)["_"]
+    return chain_times({"_": step_fn}, carry, iters, reps,
+                       null_carry=null_carry)["_"]
