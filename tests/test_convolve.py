@@ -51,20 +51,23 @@ def test_convolve_commutative(rng):
 
 def test_selector_contract():
     # Structure parity with convolve_initialize (convolve.c:328-366):
-    # long signal with small kernel -> overlap_save; balanced big -> fft;
-    # small -> direct.
-    assert ops.select_algorithm(65536, 127) == "overlap_save"
+    # small kernel -> direct (TPU shift-add beats the block FFT for
+    # h <= ~200 at any signal length); long signal with mid kernel ->
+    # overlap_save; balanced big -> fft; small -> direct.
+    assert ops.select_algorithm(65536, 127) == "direct"
+    assert ops.select_algorithm(65536, 255) == "overlap_save"
     assert ops.select_algorithm(8192, 8192) == "fft"
     assert ops.select_algorithm(64, 16) == "direct"
-    assert ops.convolve_initialize(65536, 127).algorithm == "overlap_save"
+    assert ops.convolve_initialize(65536, 255).algorithm == "overlap_save"
     assert ops.convolve_initialize(64, 16).algorithm == "direct"
     # TPU-measured refinements (tools/tune_convolve.py table):
     # large kernels never take the per-tap-unrolled direct path
     assert ops.select_algorithm(4096, 1024) == "fft"
     # batched block FFT wins as soon as there are >= 2 blocks to batch
-    assert ops.select_algorithm(16384, 127) == "overlap_save"
-    # mid-size signals (latency-bound but above the brute cutoff) take fft
-    assert ops.select_algorithm(4096, 127) == "fft"
+    assert ops.select_algorithm(16384, 255) == "overlap_save"
+    # mid-size signals above the unroll sweet spot but too short for
+    # overlap-save blocks take fft
+    assert ops.select_algorithm(4096, 300) == "fft"
 
 
 def test_os_block_policy():
@@ -108,11 +111,11 @@ def test_baseline_config(rng):
 
 
 class TestDirectOversizeFallback:
-    """Explicit algorithm="direct" beyond the windows-matrix budget must
-    still return a result (O(n)-memory conv lowering, not a 16 GB stack)."""
+    """Explicit algorithm="direct" beyond the per-tap unroll ceiling must
+    still return a result (conv lowering, not 10^5 traced slices)."""
 
     @pytest.mark.parametrize("reverse", [False, True])
-    def test_fallback_matches_windowed(self, rng, monkeypatch, reverse):
+    def test_fallback_matches_unrolled(self, rng, monkeypatch, reverse):
         import importlib
         # ops.convolve the *function* shadows the submodule attribute, so
         # "import ... as C" would bind the function; go via import_module
@@ -120,7 +123,7 @@ class TestDirectOversizeFallback:
         x = rng.normal(size=300).astype(np.float32)
         h = rng.normal(size=40).astype(np.float32)
         want = np.asarray(C._convolve_direct_xla(x, h, reverse=reverse))
-        monkeypatch.setattr(C, "_DIRECT_WINDOWS_MAX_ELEMS", 1)
+        monkeypatch.setattr(C, "_DIRECT_UNROLL_MAX_H", 1)
         C._convolve_direct_xla.clear_cache()
         try:
             got = np.asarray(C._convolve_direct_xla(x, h, reverse=reverse))

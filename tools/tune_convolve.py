@@ -50,7 +50,7 @@ def main():
             (rng.normal(size=h_len) / h_len).astype(np.float32))
         steps = {}
         for alg in ("direct", "fft", "overlap_save"):
-            if alg == "direct" and h_len > C._DIRECT_MAX_H:
+            if alg == "direct" and h_len > C._DIRECT_UNROLL_MAX_H:
                 continue  # per-tap unroll: compile time explodes
             try:
                 handle = C.convolve_initialize(x_len, h_len, algorithm=alg)

@@ -51,35 +51,38 @@ ALGORITHMS = ("direct", "fft", "overlap_save")
 # null-chain RTT correction — the axon tunnel's ~70 ms round trip swallows
 # small workloads, so every config is timed interleaved in one process and
 # the null chain's total is subtracted; tools/tune_convolve.py reproduces
-# the table).  MSamples/s, 2026-07-29:
+# the table).  MSamples/s at x=65536, 2026-07-30 (within-run ratios are
+# stable; absolute numbers drift ~2x with chip state):
 #
-#   x=4096    h=127 : direct 365   fft 3108
-#   x=65536   h=127 : direct 200   fft 251-650   os(L=8192) 2891
-#   x=262144  h=127 :              fft 465       os 701
-#   x=1048576 h=127 :              fft 1012      os 1178
-#   x=4194304 h=127 :              fft 593       os 2141
-#   x=65536   h=2047:              fft 590       os 1835
+#   h=63  : direct(shift-add) 1010   os 718
+#   h=127 : direct(shift-add)  900   os 727     (second run: 4071 vs 2051)
+#   h=255 : direct(shift-add)  670   os 718
+#   h=511 : direct(shift-add)  471   os 723
+#   h=1023: direct(shift-add)  303   os 734
 #
 # Structure mirrors convolve.c:328-366; the constants are TPU-measured.
-# Three TPU-specific facts drive them: (a) per-tap unrolling makes direct's
-# compile time linear in h, so large kernels must never take it; (b) the
-# batched block FFT beats one full-length FFT once there are >= 2 blocks to
-# batch; (c) block extraction must be reshape/concat, never gather — the
-# gather formulation ran 9x slower (131 vs 1178 MS/s at x=1M).
+# Four TPU-specific facts drive them: (a) the direct path is h fused
+# unit-stride shifted multiply-adds — one VPU pass, O(n) memory — and
+# beats the block FFT up to h ~ 200 at ANY signal length (both scale
+# linearly in x); (b) per-tap unrolling makes direct's compile time linear
+# in h, so very large kernels must never take it; (c) the batched block
+# FFT beats one full-length FFT once there are >= 2 blocks to batch;
+# (d) block extraction must be reshape/concat, never gather — the gather
+# formulation ran 9x slower (131 vs 1178 MS/s at x=1M).
 _OS_MIN_X = 16384       # >= 2 blocks of the 8192 floor: overlap-save wins
-# windows-matrix budget for the direct path: 2^26 float32 = 256 MB; past
-# this, explicit-direct falls back to the O(n)-memory conv lowering
-_DIRECT_WINDOWS_MAX_ELEMS = 1 << 26
-_DIRECT_MAX_H = 512     # above this, per-tap unroll compile cost explodes
+_DIRECT_MAX_H = 192     # shift-add beats the block FFT below this, any x
+_DIRECT_UNROLL_MAX_H = 512   # unroll ceiling: above, conv-lowering fallback
 _DIRECT_MAX_X = 1024    # tiny signals are latency-bound; keep brute parity
 _OS_BLOCK_MIN = 8192    # TPU-efficient FFT block floor (CPU policy was 4*h)
 
 
 def select_algorithm(x_length: int, h_length: int) -> str:
     """Shape-driven algorithm choice (the convolve_initialize policy)."""
+    if h_length <= _DIRECT_MAX_H:
+        return "direct"
     if x_length > 2 * h_length and x_length >= _OS_MIN_X:
         return "overlap_save"
-    if x_length <= _DIRECT_MAX_X and h_length <= _DIRECT_MAX_H:
+    if x_length <= _DIRECT_MAX_X and h_length <= _DIRECT_UNROLL_MAX_H:
         return "direct"
     return "fft"
 
@@ -101,30 +104,33 @@ def os_block_length(h_length: int) -> int:
 
 @functools.partial(jax.jit, static_argnames=("reverse",))
 def _convolve_direct_xla(x, h, reverse=False):
-    """Windowed matmul formulation of brute-force convolution.
+    """Shifted multiply-add formulation of brute-force convolution.
 
     The reference's per-output SIMD dot (convolve.c:40-101) does not map to
     TPU: lax.conv_general_dilated with N=C=1 lowers to a degenerate conv
     whose compile time grows superlinearly in the signal length (measured
-    53s at x=4096) and runs <1 MS/s. Instead, materialize the h overlapping
-    tap-diagonals with static contiguous slices (no gather — TPU gathers
-    serialize) and contract on the MXU: out = h_rev @ windows(m, x+m-1).
+    53s at x=4096) and runs <1 MS/s. Instead the m taps become m
+    unit-stride shifted multiply-adds over the padded signal — XLA fuses
+    them into one VPU pass, O(n) memory, no gather (TPU gathers
+    serialize). Measured 2x the overlap-save block FFT at h=127, x=65536
+    (selector table above); an earlier windowed-matmul variant (stack m
+    tap-diagonals, contract on the MXU) ran 4-20x slower — the (m, n+m)
+    windows matrix is pure HBM traffic.
 
-    The windows matrix is O(m*n) memory — fine in the regime the selector
-    routes here (x <= 1024, h <= 512) but a blowup for oversized explicit
-    ``algorithm="direct"`` requests, which instead take the degenerate
-    conv_general_dilated lowering: O(n) memory, slow to compile, but it
-    returns a result where the windowed form would OOM.
+    The per-tap unroll makes compile time linear in m, so oversized
+    explicit ``algorithm="direct"`` requests past _DIRECT_UNROLL_MAX_H
+    take the degenerate conv lowering: slow, but it returns a result
+    where tracing 10^5 slices would hang.
     """
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     if not reverse:
-        h = h[::-1]
+        h = h[::-1]  # correlation orientation
     n, m = x.shape[-1], h.shape[-1]
     n_out = n + m - 1
-    if m * n_out > _DIRECT_WINDOWS_MAX_ELEMS:
+    if m > _DIRECT_UNROLL_MAX_H:
         # lax conv is cross-correlation (no kernel flip) — h is already in
-        # correlation orientation here, same as the windowed branch below
+        # correlation orientation here
         lhs = x.reshape(1, 1, n)
         rhs = h.reshape(1, 1, m)
         out = jax.lax.conv_general_dilated(
@@ -132,9 +138,10 @@ def _convolve_direct_xla(x, h, reverse=False):
             dimension_numbers=("NCH", "OIH", "NCH"))
         return out.reshape(n_out)
     padded = jnp.pad(x, (m - 1, m - 1))
-    windows = jnp.stack(
-        [jax.lax.slice_in_dim(padded, j, j + n_out) for j in range(m)])
-    return (h @ windows).astype(jnp.float32)
+    acc = jnp.zeros(n_out, jnp.float32)
+    for j in range(m):
+        acc = acc + padded[j:j + n_out] * h[j]
+    return acc
 
 
 # ---------------------------------------------------------------------------

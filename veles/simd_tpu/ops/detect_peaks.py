@@ -33,6 +33,10 @@ from veles.simd_tpu.reference.detect_peaks import (  # noqa: F401 (re-export)
     EXTREMUM_TYPE_BOTH, EXTREMUM_TYPE_MAXIMUM, EXTREMUM_TYPE_MINIMUM)
 
 
+# one-hot-matvec compaction wins below this capacity; full-row sort above
+_ONEHOT_COMPACT_MAX_CAP = 128
+
+
 @functools.partial(jax.jit, static_argnames=("extremum_type", "capacity"))
 def _detect_peaks_fixed_xla(data, extremum_type, capacity):
     data = jnp.asarray(data, jnp.float32)
@@ -45,7 +49,32 @@ def _detect_peaks_fixed_xla(data, extremum_type, capacity):
     if extremum_type & EXTREMUM_TYPE_MINIMUM:
         sel = sel | (strict & (d1 < 0))
     n = data.shape[-1] - 2
-    # compaction: selected interior indices sort ahead of the sentinel n
+    if capacity <= _ONEHOT_COMPACT_MAX_CAP:
+        # Compaction on the MXU: each selected interior index has a unique
+        # rank (exclusive cumsum of sel), so slot j of the output is the
+        # single i with rank_i == j — a one-hot batched matvec against
+        # iota. Measured 3.7x faster than the sort formulation below at
+        # capacity 64 (the bitonic sort of the full row is ~140 passes);
+        # cost grows linearly in capacity, so large capacities sort.
+        # Exact in float32: indices < 2^24 and each slot sums one term.
+        rank = jnp.cumsum(sel, axis=-1) - 1
+        tgt = jnp.where(sel, rank, capacity)    # beyond-capacity -> dropped
+        onehot = (tgt[..., None, :] == jnp.arange(capacity)[:, None])
+        ohf = onehot.astype(jnp.float32)
+        iota = jnp.arange(n, dtype=jnp.float32)
+        pos = jnp.einsum("...jm,m->...j", ohf, iota,
+                         precision=jax.lax.Precision.HIGHEST)
+        # values ride the same one-hot (a take_along_axis gather here
+        # costs more than the whole compaction — TPU gathers serialize)
+        vals = jnp.einsum("...jm,...m->...j", ohf, data[..., 1:-1],
+                          precision=jax.lax.Precision.HIGHEST)
+        valid = jnp.any(onehot, axis=-1)
+        order = jnp.where(valid, pos.astype(jnp.int32), n)
+        positions = jnp.where(valid, order + 1, -1).astype(jnp.int32)
+        values = jnp.where(valid, vals, 0).astype(jnp.float32)
+        count = jnp.sum(sel, axis=-1).astype(jnp.int32)
+        return positions, values, jnp.minimum(count, capacity)
+    # compaction: selected interior indices sort ahead of sentinel n
     idx = jnp.where(sel, jnp.arange(n), n)
     order = jnp.sort(idx, axis=-1)[..., :capacity]
     valid = order < n

@@ -15,7 +15,7 @@ from jax.sharding import PartitionSpec as P
 
 from veles.simd_tpu import wavelet_data
 from veles.simd_tpu.ops.wavelet import (EXTENSION_PERIODIC, EXTENSION_ZERO,
-                                        _filter_bank_conv)
+                                        _dwt_bank, _swt_bank)
 from veles.simd_tpu.parallel.halo import halo_map
 
 _SHARDABLE_EXT = {EXTENSION_PERIODIC: "periodic", EXTENSION_ZERO: "zero"}
@@ -77,8 +77,8 @@ def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
 
     def local(x_ext, filters):
         half = (x_ext.shape[-1] - order) // 2
-        out = _filter_bank_conv(x_ext, filters, 2, 1, half)
-        return jnp.concatenate([out[..., 0, :], out[..., 1, :]], axis=-1)
+        hi_b, lo_b = _dwt_bank(x_ext, filters, half)
+        return jnp.concatenate([hi_b, lo_b], axis=-1)
 
     fn = halo_map(local, mesh, axis, right=order, boundary=boundary,
                   n_broadcast_args=1)
@@ -102,8 +102,8 @@ def stationary_wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
 
     def local(x_ext, filters):
         n_local = x_ext.shape[-1] - span
-        out = _filter_bank_conv(x_ext, filters, 1, stride, n_local)
-        return jnp.concatenate([out[..., 0, :], out[..., 1, :]], axis=-1)
+        hi_b, lo_b = _swt_bank(x_ext, filters, stride, n_local)
+        return jnp.concatenate([hi_b, lo_b], axis=-1)
 
     fn = halo_map(local, mesh, axis, right=span, boundary=boundary,
                   n_broadcast_args=1)

@@ -53,8 +53,10 @@ def bench_convolve(scale=1):
     import jax.numpy as jnp
     import numpy as np
 
-    from veles.simd_tpu.ops.convolve import (_convolve_overlap_save_xla,
+    from veles.simd_tpu.ops.convolve import (_convolve_direct_xla,
+                                             _convolve_overlap_save_xla,
                                              os_block_length)
+    from veles.simd_tpu.utils.benchlib import chain_times
 
     n, m = int(65536 * scale), 127
     rng = np.random.default_rng(0)
@@ -64,14 +66,21 @@ def bench_convolve(scale=1):
     if L > n:  # CPU smoke fallback scale shrinks n below the block floor
         L = max(256, 2 * m)
 
-    def step(c):
+    def step_os(c):
         out = _convolve_overlap_save_xla(c, h, L=L, out_length=n + m - 1)
         return out[:n]  # keep the carry shape fixed
 
-    dt = chain_time(step, x, iters=1024)
-    return {"metric": f"convolve_os_n{n}_m{m}",
-            "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None}
+    def step_direct(c):
+        # what the auto-selector actually picks for h=127 (shift-add)
+        return _convolve_direct_xla(c, h)[:n]
+
+    dts = chain_times({"os": step_os, "direct": step_direct}, x, iters=1024)
+    best = min(dts.values())
+    return {"metric": f"convolve_n{n}_m{m}",
+            "value": round(n / best / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None,
+            "overlap_save_msps": round(n / dts["os"] / 1e6, 1),
+            "direct_shift_msps": round(n / dts["direct"] / 1e6, 1)}
 
 
 def bench_dwt(scale=1):
@@ -98,7 +107,9 @@ def bench_dwt(scale=1):
         # fold the cascade back into a fixed-shape carry
         return c + jnp.pad(lo_band * 0, (0, n - lo_band.shape[-1])) + acc / n
 
-    dt = chain_time(six_level, x, iters=256)
+    # the polyphase DWT runs ~70 us/transform; thousands of chained steps
+    # are needed for device time to dominate the ~100 ms tunnel RTT floor
+    dt = chain_time(six_level, x, iters=4096)
     return {"metric": f"dwt_db8_6level_n{n}",
             "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}
@@ -120,7 +131,7 @@ def bench_batched_pipeline(scale=1):
         _, vals, _ = _detect_peaks_fixed_xla(norm, 3, 64)
         return norm + jnp.float32(1e-6) * jnp.sum(vals) / n
 
-    dt = chain_time(step, x, iters=256)
+    dt = chain_time(step, x, iters=2048)
     return {"metric": f"normalize_peaks_b{batch}_n{n}",
             "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}
