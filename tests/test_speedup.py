@@ -1,0 +1,46 @@
+"""Speedup-table harness tests (benchmark.inc analogue) — machinery only;
+real numbers come from TPU runs of tools/speedup_table.py."""
+
+import io
+
+import numpy as np
+
+
+def test_host_seconds_measures():
+    from veles.simd_tpu.utils.speedup import _host_seconds
+
+    calls = []
+    dt = _host_seconds(lambda: calls.append(1), reps=2)
+    assert dt >= 0
+    assert len(calls) >= 3  # warmup + calibration + timed
+
+
+def test_speedup_table_tiny_config_runs():
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.utils.speedup import speedup_table
+
+    x = jnp.ones(512, jnp.float32)
+    cfg = [(
+        "tiny scale",
+        lambda: np.ones(512) * 0.5,
+        lambda c: c * jnp.float32(0.999) + jnp.float32(0.001),
+        x, 64)]
+    stream = io.StringIO()
+    rows = speedup_table(cfg, stream=stream)
+    assert len(rows) == 1
+    name, host_s, tpu_s, speed = rows[0]
+    assert name == "tiny scale" and host_s > 0
+    assert "tiny scale" in stream.getvalue()
+    assert "Speedup is" in stream.getvalue()
+
+
+def test_default_configs_build():
+    # construction only (no timing): exercises every lambda's closure setup
+    from veles.simd_tpu.utils.speedup import default_configs
+
+    cfgs = default_configs()
+    assert len(cfgs) >= 6
+    names = [c[0] for c in cfgs]
+    assert any("matrix_multiply" in n for n in names)
+    assert any("wavelet" in n for n in names)

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Print the host-reference vs TPU speedup table (benchmark.inc UX).
+
+Usage: python tools/speedup_table.py [--markdown]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--markdown", action="store_true",
+                    help="also emit a markdown table on stdout")
+    args = ap.parse_args()
+
+    from veles.simd_tpu.utils.speedup import speedup_table
+
+    rows = speedup_table(stream=sys.stderr)
+    if args.markdown:
+        print("| Op | host ref (ms) | TPU (ms) | speedup |")
+        print("|---|---|---|---|")
+        for name, host_s, tpu_s, speed in rows:
+            print(f"| {name} | {host_s * 1e3:.3f} | {tpu_s * 1e3:.4f} | "
+                  f"{speed:.1f}x |")
+
+
+if __name__ == "__main__":
+    main()

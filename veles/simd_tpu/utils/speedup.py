@@ -1,0 +1,159 @@
+"""Host-reference vs TPU speedup table — tests/benchmark.inc reborn.
+
+The reference's benchmark generator times the SIMD closure against the
+scalar baseline and prints "SIMD version took N% of original time.
+Speedup is N% (X.x times)" (tests/benchmark.inc:61-113). The TPU frame
+has two machines instead of two code paths on one machine, so the twin
+here times the NumPy host oracle (vectorized x86 — the practical "AVX
+baseline" available in-process) against the jitted TPU path, per op, and
+prints the same shape of line. This is the "AVX→TPU speedup" metric of
+BASELINE.json.
+
+Host timing is plain perf_counter min-of-reps (NumPy is synchronous);
+TPU timing goes through utils.benchlib's chained-scan + RTT-corrected
+protocol, since naive per-dispatch timing on the tunneled chip measures
+only the round trip.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from veles.simd_tpu.utils.benchlib import chain_times
+
+
+def _host_seconds(fn, reps=5, min_iters=1):
+    """Best-of-reps seconds for one synchronous host call."""
+    # calibrate iteration count to ~20 ms so timer noise stays small
+    fn()
+    t0 = time.perf_counter()
+    fn()
+    once = max(time.perf_counter() - t0, 1e-9)
+    iters = max(min_iters, int(0.02 / once))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def default_configs():
+    """(name, host_fn, tpu_step_fn, carry, iters) per op family.
+
+    Shapes follow the reference benchmark instantiations
+    (tests/convolve.cc:171-400, tests/matrix.cc:206-288,
+    tests/wavelet.cc:292-334) scaled to TPU-meaningful sizes — the same
+    shapes BASELINE.md records.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import ops, reference
+
+    rng = np.random.default_rng(0)
+    cfgs = []
+
+    # matrix_multiply 1024x1024 (tests/matrix.cc:206-231 scaled up)
+    n = 1024
+    a64 = rng.normal(size=(n, n))
+    b64 = rng.normal(size=(n, n)) / np.sqrt(n)
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    def mm_step(c, b=b):
+        out = ops.matrix_multiply(c, b)
+        # renormalize: keeps the chained power iteration bounded
+        return out * jax.lax.rsqrt(jnp.mean(out * out) + 1e-6)
+
+    cfgs.append((
+        f"matrix_multiply {n}x{n}",
+        lambda a64=a64, b64=b64: reference.matrix.matrix_multiply(a64, b64),
+        mm_step, a, 2048))
+
+    # convolve x=65536 h=127 (auto-selected direct path)
+    xs = rng.normal(size=65536).astype(np.float32)
+    h = (rng.normal(size=127) / 127).astype(np.float32)
+    xj, hj = jnp.asarray(xs), jnp.asarray(h)
+    from veles.simd_tpu.ops.convolve import _convolve_direct_xla
+    cfgs.append((
+        "convolve 65536*127",
+        lambda xs=xs, h=h: reference.convolve.convolve(xs, h),
+        lambda c, hj=hj: _convolve_direct_xla(c, hj)[:65536],
+        xj, 4096))
+
+    # DWT db8, N=262144 (tests/wavelet.cc order sweep shape scaled)
+    xw = rng.normal(size=262144).astype(np.float32)
+    xwj = jnp.asarray(xw)
+    cfgs.append((
+        "wavelet_apply db8 262144",
+        lambda xw=xw: reference.wavelet.wavelet_apply(
+            xw, "daubechies", 8, "periodic"),
+        lambda c: jnp.concatenate(
+            ops.wavelet_apply(c, "daubechies", 8, "periodic", impl="xla")),
+        xwj, 2048))
+
+    # SWT db8 level 3 (output scaled so the chained carry stays bounded —
+    # the lowpass gain is sqrt(2) per application)
+    cfgs.append((
+        "stationary_wavelet db8 L3 262144",
+        lambda xw=xw: reference.wavelet.stationary_wavelet_apply(
+            xw, "daubechies", 8, 3, "periodic"),
+        lambda c: ops.stationary_wavelet_apply(
+            c, "daubechies", 8, 3, "periodic",
+            impl="xla")[1] * jnp.float32(1 / np.sqrt(2)),
+        xwj, 16384))
+
+    # batched normalize + detect_peaks 256x4096
+    xb = rng.normal(size=(256, 4096)).astype(np.float32)
+    xbj = jnp.asarray(xb)
+
+    def host_norm_peaks(xb=xb):
+        for row in xb[:8]:  # reference impl is 1-D; sample 8 rows
+            nrm = reference.normalize.normalize1D(row)
+            reference.detect_peaks.detect_peaks(nrm, 3)
+
+    def tpu_norm_peaks(c):
+        nrm = ops.normalize1D(c, impl="xla")
+        _, vals, _ = ops.detect_peaks_fixed(nrm, 3, capacity=64, impl="xla")
+        return c + jnp.sum(vals) * jnp.float32(1e-9)
+
+    cfgs.append(("normalize+detect_peaks 256x4096 (host: 8 rows)",
+                 host_norm_peaks, tpu_norm_peaks, xbj, 1024, 32.0))
+
+    # sin_psv 1M (mathfun.h:142)
+    xm = rng.normal(size=1 << 20).astype(np.float32)
+    xmj = jnp.asarray(xm)
+    cfgs.append((
+        "sin_psv 1M",
+        lambda xm=xm: reference.mathfun.sin_psv(xm),
+        lambda c: ops.sin_psv(c, impl="xla") * jnp.float32(0.99),
+        xmj, 8192))
+
+    return cfgs
+
+
+def speedup_table(configs=None, stream=None):
+    """Measure all configs; returns rows of (name, host_s, tpu_s, speedup)
+    and prints benchmark.inc-style lines to ``stream`` if given."""
+    if configs is None:
+        configs = default_configs()
+
+    rows = []
+    for cfg in configs:
+        name, host_fn, tpu_fn, carry, iters = cfg[:5]
+        host_scale = cfg[5] if len(cfg) > 5 else 1.0
+        host_s = _host_seconds(host_fn) * host_scale
+        tpu_s = chain_times({"op": tpu_fn}, carry, iters,
+                            null_carry=np.zeros(8, np.float32),
+                            on_floor="nan")["op"]
+        ratio = tpu_s / host_s
+        rows.append((name, host_s, tpu_s, 1.0 / ratio))
+        if stream is not None:
+            # tests/benchmark.inc:108-113 line shape
+            print(f"[{name}] TPU version took {ratio * 100:.2f}% of host "
+                  f"reference time. Speedup is {1 / ratio:.1f} times",
+                  file=stream, flush=True)
+    return rows
