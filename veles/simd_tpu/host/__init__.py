@@ -217,16 +217,24 @@ def align_complement_i32(a: np.ndarray) -> int:
     return align_complement(a, 32)
 
 
-def convert(src: np.ndarray, to_dtype) -> np.ndarray:
+def convert(src: np.ndarray, to_dtype, out: np.ndarray = None) -> np.ndarray:
     """Host-side staging conversion with saturating narrows
-    (arithmetic-inl.h:43-85 semantics; device twins in ops.arithmetic)."""
+    (arithmetic-inl.h:43-85 semantics; device twins in ops.arithmetic).
+
+    ``out``, when given, receives the result in place (must be contiguous
+    1-D of ``to_dtype`` with ``src.size`` elements — e.g. a StagingPool
+    slot view, so the feed path converts straight into pooled memory)."""
     to_dtype = np.dtype(to_dtype)
     if src.ndim != 1 or not src.flags.c_contiguous:
         raise ValueError("src must be contiguous 1-D")
     key = (src.dtype, to_dtype)
     if key not in _CONVERSIONS:
         raise ValueError(f"unsupported conversion {src.dtype} -> {to_dtype}")
-    out = aligned_empty(src.size, to_dtype)
+    if out is None:
+        out = aligned_empty(src.size, to_dtype)
+    elif (out.ndim != 1 or not out.flags.c_contiguous
+          or out.dtype != to_dtype or out.size != src.size):
+        raise ValueError("out must be contiguous 1-D of to_dtype, same size")
     lib = _native.load()
     if lib is None:
         if np.issubdtype(to_dtype, np.integer) and src.dtype == np.float32:
@@ -366,7 +374,12 @@ class StagingPool:
 
 
 def to_device(host_array: np.ndarray, sharding=None):
-    """``jax.device_put`` of a staged buffer (copies out of the pool —
-    release the lease after this returns)."""
+    """``jax.device_put`` of a staged buffer.
+
+    The transfer is asynchronous: the buffer must stay valid (lease held)
+    until the returned array is ready — releasing a pool slot right after
+    this returns lets the next batch overwrite memory the transfer engine
+    is still reading. ``FeedPipeline`` manages that lifetime; manual users
+    should ``block_until_ready()`` before releasing."""
     import jax
     return jax.device_put(np.ascontiguousarray(host_array), sharding)

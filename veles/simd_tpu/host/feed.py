@@ -1,0 +1,190 @@
+"""Asynchronous host->device feed executor (double-buffered data loader).
+
+The reference library has no device to feed (single-process CPU,
+SURVEY §2); a TPU framework's host runtime does, and the transfer must
+overlap device compute or HBM sits idle between batches. ``FeedPipeline``
+is that executor: a background worker pulls items from a source iterator,
+stages each into a pooled aligned buffer (``StagingPool`` — C++ fill and
+dtype conversion via native/veles_host.cpp), dispatches
+``jax.device_put`` (asynchronous in JAX), and hands device arrays to the
+consumer through a bounded queue:
+
+    with FeedPipeline(batches, dtype=np.float32, depth=2) as feed:
+        for dev_batch in feed:          # already in flight / on device
+            out = step(dev_batch)
+
+Ordering is preserved; worker exceptions surface on the consumer's next
+``__next__``. ``depth`` bounds host memory: at most ``depth + 1`` staged
+buffers exist (the +1 is the slot being filled while ``depth`` transfers
+are in flight). A staging slot is only reused after the transfer that
+read from it has materialized on device (``block_until_ready`` on the
+oldest in-flight array before the next acquire), so the device never
+reads from a recycled buffer.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+
+import numpy as np
+
+from . import StagingPool, convert, to_device
+
+_STOP = object()
+
+
+class FeedPipeline:
+    """Background staged host->device feed over ``source`` items.
+
+    Parameters
+    ----------
+    source : iterable of np.ndarray-likes (uniform nbytes upper bound)
+    dtype : staged/target dtype; items of other dtypes are converted on
+        the host (native path when available — the arithmetic-inl.h
+        conversions' role in the feed)
+    depth : max in-flight device transfers (queue bound)
+    nbytes : staging slot size; default = first item's converted nbytes
+    sharding : optional jax sharding for device_put
+    """
+
+    def __init__(self, source, *, dtype=np.float32, depth: int = 2,
+                 nbytes: int | None = None, sharding=None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._source = iter(source)
+        self._dtype = np.dtype(dtype)
+        self._depth = depth
+        self._sharding = sharding
+        self._nbytes = nbytes
+        self._pool = None
+        self._inflight: collections.deque = collections.deque()
+        self._cpu_target = None
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._exc: BaseException | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="veles-feed")
+        self._started = False
+
+    # -- worker side --------------------------------------------------
+
+    def _target_is_cpu(self) -> bool:
+        if self._cpu_target is None:
+            import jax
+            if self._sharding is not None:
+                devs = getattr(self._sharding, "device_set", None)
+                dev = next(iter(devs)) if devs else jax.devices()[0]
+            else:
+                dev = jax.devices()[0]
+            self._cpu_target = dev.platform == "cpu"
+        return self._cpu_target
+
+    def _stage(self, item):
+        item = np.asarray(item)
+        if self._pool is None:
+            slot_bytes = self._nbytes or (item.size * self._dtype.itemsize)
+            self._pool = StagingPool(slot_bytes, count=self._depth + 1)
+        slot, buf = self._pool.acquire(item.shape, self._dtype)
+        try:
+            if item.dtype == self._dtype:
+                buf[:] = item
+            else:
+                convert(np.ascontiguousarray(item).ravel(), self._dtype,
+                        out=buf.reshape(-1))
+            # On a CPU backend jax.device_put is zero-copy: the returned
+            # array ALIASES the pool slot permanently, so the slot must
+            # be deep-copied out. On an accelerator the put is a real DMA
+            # and the pooled buffer only needs to live until it's done.
+            src = buf.copy() if self._target_is_cpu() else buf
+            dev = to_device(src, self._sharding)
+        except BaseException:
+            self._pool.release(slot)
+            raise
+        # device_put is async and reads from the pool slot — hold the
+        # lease until the transfer has materialized. Slots released once
+        # more than `depth` transfers are in flight (pool never grows
+        # past depth + 1).
+        self._inflight.append((dev, slot))
+        while len(self._inflight) > self._depth:
+            old_dev, old_slot = self._inflight.popleft()
+            old_dev.block_until_ready()
+            self._pool.release(old_slot)
+        return dev
+
+    def _drain_inflight(self):
+        while self._inflight:
+            dev, slot = self._inflight.popleft()
+            try:
+                dev.block_until_ready()
+            except Exception:
+                pass
+            self._pool.release(slot)
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    break
+                dev = self._stage(item)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(dev, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    break
+            else:
+                self._queue.put(_STOP)
+        except BaseException as e:  # surface on the consumer side
+            self._exc = e
+            try:
+                self._queue.put(_STOP, timeout=1.0)
+            except queue.Full:
+                pass
+        finally:
+            if self._pool is not None:
+                self._drain_inflight()
+
+    # -- consumer side ------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        item = self._queue.get()
+        if item is _STOP:
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the worker and drop queued work. Idempotent."""
+        self._stop.set()
+        if self._started:
+            while True:  # drain so a blocked put can finish
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=5.0)
+        if self._pool is not None:
+            try:
+                self._pool.close()
+            except RuntimeError:
+                pass  # a lease may be live if the worker died mid-stage
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
