@@ -1,0 +1,103 @@
+"""Composed model tests: matched filter finds injected templates, the
+denoiser actually denoises, the flagship pipeline jits and batches."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.models import (MatchedFilterDetector, SignalPipeline,
+                                   WaveletDenoiser)
+
+
+class TestMatchedFilter:
+    def test_finds_injected_template(self, rng):
+        n, m = 1024, 31
+        t = np.hanning(m).astype(np.float32)
+        sig = 0.05 * rng.normal(size=n).astype(np.float32)
+        where = [200, 700]
+        for w in where:
+            sig[w:w + m] += t
+        det = MatchedFilterDetector(t[None, :], capacity=4, normalize=False)
+        scores, lags, values, counts = det(sig[None, :])
+        assert scores.shape == (1, 1, n + m - 1)
+        top2 = np.asarray(lags[0, 0])[np.argsort(-np.asarray(values[0, 0]))][:2]
+        assert sorted(top2.tolist()) == where
+
+    def test_template_bank_batched(self, rng):
+        n, m, k, b = 512, 16, 3, 4
+        bank = rng.normal(size=(k, m)).astype(np.float32)
+        sigs = rng.normal(size=(b, n)).astype(np.float32)
+        det = MatchedFilterDetector(bank, capacity=8)
+        scores, lags, values, counts = det(sigs)
+        assert scores.shape == (b, k, n + m - 1)
+        assert lags.shape == (b, k, 8)
+        assert counts.shape == (b, k)
+
+    def test_scores_match_reference_correlation(self, rng):
+        from veles.simd_tpu.reference import correlate as rc
+        n, m = 128, 9
+        sig = rng.normal(size=n).astype(np.float32)
+        t = rng.normal(size=m).astype(np.float32)
+        det = MatchedFilterDetector(t[None], capacity=4, normalize=False)
+        scores, *_ = det(sig[None])
+        want = rc.cross_correlate(sig, t)
+        np.testing.assert_allclose(np.asarray(scores[0, 0]), want,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MatchedFilterDetector(np.zeros((2, 2, 2), np.float32))
+        with pytest.raises(ValueError):
+            MatchedFilterDetector(np.zeros((1, 4), np.float32), capacity=0)
+
+
+class TestWaveletDenoiser:
+    def test_reduces_noise_mse(self, rng):
+        n = 1024
+        tt = np.linspace(0, 6 * np.pi, n)
+        clean = np.sin(tt).astype(np.float32)
+        noisy = clean + 0.3 * rng.normal(size=n).astype(np.float32)
+        den = WaveletDenoiser("daubechies", 8, levels=4)
+        out = np.asarray(den(noisy))
+        assert out.shape == (n,)
+        mse_before = np.mean((noisy - clean) ** 2)
+        mse_after = np.mean((out - clean) ** 2)
+        assert mse_after < 0.35 * mse_before
+
+    def test_zero_noise_near_identity(self, rng):
+        n = 512
+        clean = np.sin(np.linspace(0, 4 * np.pi, n)).astype(np.float32)
+        out = np.asarray(WaveletDenoiser(levels=3, threshold=0.0)(clean))
+        np.testing.assert_allclose(out, clean, atol=1e-4)
+
+    def test_batched_and_hard_mode(self, rng):
+        x = rng.normal(size=(3, 256)).astype(np.float32)
+        out = WaveletDenoiser(mode="hard", levels=2)(x)
+        assert out.shape == (3, 256)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            WaveletDenoiser(mode="medium")
+        with pytest.raises(ValueError):
+            WaveletDenoiser(levels=0)
+
+
+class TestSignalPipeline:
+    def test_jits_and_shapes(self, rng):
+        import jax
+
+        b, n, k, m = 4, 128, 8, 15
+        sig = rng.normal(size=(b, n)).astype(np.float32)
+        fir = rng.normal(size=m).astype(np.float32)
+        w = (0.01 * rng.normal(size=(3 * n, k))).astype(np.float32)
+        pipe = SignalPipeline()
+        out = jax.jit(pipe)(sig, fir, w)
+        assert out.shape == (b, k)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_graft_entry_uses_pipeline(self):
+        import __graft_entry__ as g
+        import jax
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (8, 16)

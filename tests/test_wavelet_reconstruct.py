@@ -1,0 +1,111 @@
+"""Inverse wavelet transforms (beyond-parity: the reference ships
+analysis only). Perfect-reconstruction roundtrips are the ground truth —
+every family is orthogonal, so synthesis = transposed analysis up to the
+table normalization gain."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.reference import wavelet as ref
+
+
+FAMILIES = [("daubechies", 2), ("daubechies", 8), ("daubechies", 16),
+            ("symlet", 6), ("symlet", 12), ("coiflet", 6), ("coiflet", 12)]
+
+
+@pytest.mark.parametrize("family,order", FAMILIES)
+def test_reference_idwt_roundtrip(rng, family, order):
+    x = rng.normal(size=128)
+    hi, lo = ref.wavelet_apply(x, family, order, "periodic")
+    back = ref.wavelet_reconstruct(hi, lo, family, order)
+    np.testing.assert_allclose(back, x, atol=1e-12)
+
+
+@pytest.mark.parametrize("family,order", FAMILIES)
+def test_reference_iswt_roundtrip(rng, family, order):
+    x = rng.normal(size=96)
+    for level in (1, 2, 3):
+        hi, lo = ref.stationary_wavelet_apply(x, family, order, level,
+                                              "periodic")
+        back = ref.stationary_wavelet_reconstruct(hi, lo, family, order,
+                                                  level)
+        np.testing.assert_allclose(back, x, atol=1e-12)
+
+
+@pytest.mark.parametrize("family,order", FAMILIES)
+def test_xla_idwt_roundtrip(rng, family, order):
+    x = rng.normal(size=256).astype(np.float32)
+    hi, lo = ops.wavelet_apply(x, family, order, "periodic", impl="xla")
+    back = np.asarray(ops.wavelet_reconstruct(hi, lo, family, order,
+                                              impl="xla"))
+    np.testing.assert_allclose(back, x, atol=2e-5)
+
+
+@pytest.mark.parametrize("family,order", [("daubechies", 8), ("symlet", 6)])
+def test_xla_iswt_roundtrip(rng, family, order):
+    x = rng.normal(size=160).astype(np.float32)
+    for level in (1, 2, 3):
+        hi, lo = ops.stationary_wavelet_apply(x, family, order, level,
+                                              "periodic", impl="xla")
+        back = np.asarray(ops.stationary_wavelet_reconstruct(
+            hi, lo, family, order, level, impl="xla"))
+        np.testing.assert_allclose(back, x, atol=2e-5)
+
+
+def test_xla_matches_reference_oracle(rng):
+    hi = rng.normal(size=64).astype(np.float32)
+    lo = rng.normal(size=64).astype(np.float32)
+    want = ref.wavelet_reconstruct(hi, lo, "daubechies", 8)
+    got = np.asarray(ops.wavelet_reconstruct(hi, lo, "daubechies", 8,
+                                             impl="xla"))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_batched_reconstruct(rng):
+    x = rng.normal(size=(5, 128)).astype(np.float32)
+    hi, lo = ops.wavelet_apply(x, "daubechies", 8, "periodic", impl="xla")
+    back = np.asarray(ops.wavelet_reconstruct(hi, lo, impl="xla"))
+    np.testing.assert_allclose(back, x, atol=2e-5)
+
+
+def test_multilevel_recompose_roundtrip(rng):
+    x = rng.normal(size=256).astype(np.float32)
+    details, approx = ops.wavelet_decompose(x, 4, "daubechies", 8,
+                                            "periodic", impl="xla")
+    back = np.asarray(ops.wavelet_recompose(details, approx, "daubechies", 8,
+                                            impl="xla"))
+    np.testing.assert_allclose(back, x, atol=5e-5)
+
+
+def test_stationary_multilevel_recompose_roundtrip(rng):
+    x = rng.normal(size=128).astype(np.float32)
+    details, approx = ops.stationary_wavelet_decompose(
+        x, 3, "daubechies", 8, "periodic", impl="xla")
+    back = np.asarray(ops.stationary_wavelet_recompose(
+        details, approx, "daubechies", 8, impl="xla"))
+    np.testing.assert_allclose(back, x, atol=5e-5)
+
+
+def test_nonperiodic_raises(rng):
+    hi = lo = rng.normal(size=32).astype(np.float32)
+    for impl in ("reference", "xla"):
+        with pytest.raises(ValueError, match="periodic"):
+            ops.wavelet_reconstruct(hi, lo, ext="mirror", impl=impl)
+        with pytest.raises(ValueError, match="periodic"):
+            ops.stationary_wavelet_reconstruct(hi, lo, ext="zero", impl=impl)
+
+
+def test_bad_order_raises(rng):
+    hi = lo = rng.normal(size=32).astype(np.float32)
+    with pytest.raises(ValueError, match="order"):
+        ops.wavelet_reconstruct(hi, lo, "coiflet", 8, impl="xla")
+
+
+def test_odd_length_lane_interleave(rng):
+    # half = 70: not a multiple of 128 — exercises the pad/trim path
+    x = rng.normal(size=140).astype(np.float32)
+    hi, lo = ops.wavelet_apply(x, "daubechies", 4, "periodic", impl="xla")
+    back = np.asarray(ops.wavelet_reconstruct(hi, lo, "daubechies", 4,
+                                              impl="xla"))
+    np.testing.assert_allclose(back, x, atol=2e-5)

@@ -1,0 +1,18 @@
+"""Composed signal-processing models built from the operator layer.
+
+The reference is a kernel library — its "models" are the call patterns
+its tests compose (filter -> transform -> detect). Here those patterns
+are first-class, jittable, batched, and mesh-shardable:
+
+  MatchedFilterDetector  normalize -> template-bank cross-correlation ->
+                         peak extraction (the correlate.h + detect_peaks.h
+                         composition, tests/correlate.cc usage)
+  WaveletDenoiser        SWT -> soft-threshold -> inverse SWT (built on
+                         the beyond-parity reconstruction ops)
+  SignalPipeline         normalize -> FIR -> SWT feature bands -> linear
+                         head (the flagship __graft_entry__ workload)
+"""
+
+from veles.simd_tpu.models.matched_filter import MatchedFilterDetector  # noqa: F401
+from veles.simd_tpu.models.denoiser import WaveletDenoiser  # noqa: F401
+from veles.simd_tpu.models.pipeline import SignalPipeline  # noqa: F401

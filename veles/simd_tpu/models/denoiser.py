@@ -1,0 +1,73 @@
+"""Wavelet shrinkage denoiser: SWT -> threshold details -> inverse SWT.
+
+Donoho-Johnstone wavelet shrinkage on the stationary (shift-invariant)
+transform — the standard use of the reference's SWT machinery, made
+possible end-to-end here by the beyond-parity inverse transform
+(ops.stationary_wavelet_reconstruct). Noise scale is estimated from the
+level-1 detail band via the median absolute deviation (sigma =
+MAD / 0.6745); the universal threshold is sigma * sqrt(2 ln n).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu import ops
+
+_MAD_TO_SIGMA = 1.0 / 0.6745
+
+
+@functools.partial(jax.jit, static_argnames=("wavelet_type", "order",
+                                             "levels", "mode"))
+def _denoise(x, wavelet_type, order, levels, mode, threshold):
+    x = jnp.asarray(x, jnp.float32)
+    details, approx = ops.stationary_wavelet_decompose(
+        x, levels, wavelet_type, order, "periodic", impl="xla")
+    if threshold is None:
+        sigma = jnp.median(jnp.abs(details[0]), axis=-1,
+                           keepdims=True) * _MAD_TO_SIGMA
+        lam = sigma * np.sqrt(2.0 * np.log(x.shape[-1]))
+    else:
+        lam = jnp.asarray(threshold, jnp.float32)
+    out_details = []
+    for d in details:
+        if mode == "soft":
+            d = jnp.sign(d) * jnp.maximum(jnp.abs(d) - lam, 0.0)
+        else:  # hard
+            d = jnp.where(jnp.abs(d) > lam, d, 0.0)
+        out_details.append(d)
+    return ops.stationary_wavelet_recompose(
+        out_details, approx, wavelet_type, order, impl="xla")
+
+
+class WaveletDenoiser:
+    """Shift-invariant wavelet shrinkage.
+
+        den = WaveletDenoiser("daubechies", 8, levels=4)
+        clean = den(noisy)            # (..., n), n divisible by 1
+
+    ``threshold=None`` -> universal threshold from the MAD noise
+    estimate, per signal; or pass a fixed float. ``mode``: "soft"
+    (shrink) or "hard" (keep/kill).
+    """
+
+    def __init__(self, wavelet_type: str = "daubechies", order: int = 8,
+                 *, levels: int = 4, mode: str = "soft",
+                 threshold: float | None = None):
+        if mode not in ("soft", "hard"):
+            raise ValueError("mode must be 'soft' or 'hard'")
+        if levels < 1:
+            raise ValueError("levels must be >= 1")
+        self.wavelet_type = wavelet_type
+        self.order = int(order)
+        self.levels = int(levels)
+        self.mode = mode
+        self.threshold = threshold
+
+    def __call__(self, x):
+        return _denoise(x, self.wavelet_type, self.order, self.levels,
+                        self.mode, self.threshold)

@@ -1,0 +1,52 @@
+"""The flagship end-to-end signal pipeline (also the driver's graft
+entry workload): normalize -> FIR filter -> stationary-wavelet feature
+bands -> linear head on the MXU.
+
+Jit-traceable end to end (static shapes only), batched over the leading
+axis, and shardable: __graft_entry__.dryrun_multichip runs this exact
+composition under shard_map on a {data, seq} mesh — batch over data,
+sequence halos over ICI, the head contraction psum-reduced by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu import ops
+
+
+class SignalPipeline:
+    """normalize -> FIR -> SWT bands (db``order`` level 1) -> linear head.
+
+        pipe = SignalPipeline()
+        out = pipe(signal, fir, weights)    # (B, K)
+
+    signal (B, N) float32; fir (M,) taps; weights (3N, K). Pure function
+    of its inputs — parameters are passed per call so the same instance
+    jits once per shape set.
+    """
+
+    def __init__(self, wavelet_type: str = "daubechies", order: int = 4,
+                 ext: str = "periodic"):
+        self.wavelet_type = wavelet_type
+        self.order = int(order)
+        self.ext = ext
+
+    def __call__(self, signal, fir, weights):
+        x = ops.normalize1D(signal, impl="xla")
+
+        # FIR filtering, same-length output (truncated linear convolution)
+        m = fir.shape[-1]
+        lhs = x[:, None, :]
+        rhs = fir[::-1][None, None, :]
+        y = jax.lax.conv_general_dilated(
+            lhs, rhs, (1,), [(m - 1, 0)],
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        y = y[:, 0, :]
+
+        # stationary wavelet feature bands — full-length hi/lo
+        bhi, blo = ops.stationary_wavelet_apply(
+            y, self.wavelet_type, self.order, 1, self.ext, impl="xla")
+        feats = jnp.concatenate([y, bhi, blo], axis=-1)   # (B, 3N)
+        return ops.matrix_multiply(feats, weights)        # MXU head

@@ -26,8 +26,10 @@ from veles.simd_tpu.ops.detect_peaks import (  # noqa: F401
 from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_CONSTANT, EXTENSION_MIRROR, EXTENSION_PERIODIC, EXTENSION_TYPES,
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,
+    stationary_wavelet_recompose, stationary_wavelet_reconstruct,
     wavelet_allocate_destination, wavelet_apply, wavelet_decompose,
-    wavelet_prepare_array, wavelet_recycle_source, wavelet_validate_order)
+    wavelet_prepare_array, wavelet_recompose, wavelet_reconstruct,
+    wavelet_recycle_source, wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate_fft, cross_correlate_finalize,
     cross_correlate_initialize, cross_correlate_overlap_save,

@@ -78,3 +78,75 @@ def stationary_wavelet_apply(src, wavelet_type="daubechies", order=8, level=1,
     windows = np.lib.stride_tricks.sliding_window_view(x, size, axis=-1)
     windows = windows[..., 0:n, :]
     return windows @ hi_f, windows @ lo_f
+
+
+def wavelet_reconstruct(desthi, destlo, wavelet_type="daubechies", order=8,
+                        ext=EXTENSION_PERIODIC):
+    """Inverse decimated DWT step (synthesis filter bank) -> length-2d src.
+
+    Beyond-parity capability: the reference ships only the analysis
+    direction (src/wavelet.c has no inverse). For its orthogonal families
+    the synthesis frame is the analysis frame transposed:
+
+        x[2t+p] = (1/c) * sum_k f_lo[2k+p]*lo[t-k] + f_hi[2k+p]*hi[t-k]
+
+    with band indices mod d (periodic) and c = sum(f_lo^2) compensating
+    the table normalization (Daubechies tables are unit-norm, symlet/
+    coiflet tables sum to 1 -> c = 1/2, matching the reference's own
+    coefficient data). Exact (1e-15) for ``ext="periodic"``; other
+    extension modes are not invertible from one level's bands alone and
+    raise.
+    """
+    if ext != EXTENSION_PERIODIC:
+        raise ValueError("reconstruction requires ext='periodic' "
+                         "(other modes discard boundary information)")
+    hi = np.asarray(desthi, dtype=np.float64)
+    lo = np.asarray(destlo, dtype=np.float64)
+    if hi.shape != lo.shape:
+        raise ValueError("desthi/destlo shapes differ")
+    half = hi.shape[-1]
+    hi_f, lo_f = wavelet_data.highpass_lowpass(wavelet_type, order, np.float64)
+    gain = 1.0 / np.sum(lo_f * lo_f)
+    ht = order // 2
+    d = np.arange(half)
+    out = np.zeros(hi.shape[:-1] + (2 * half,))
+    for p in (0, 1):
+        acc = np.zeros(hi.shape[:-1] + (half,))
+        for k in range(ht):
+            idx = (d - k) % half
+            acc = acc + lo_f[2 * k + p] * lo[..., idx] \
+                      + hi_f[2 * k + p] * hi[..., idx]
+        out[..., p::2] = acc * gain
+    return out
+
+
+def stationary_wavelet_reconstruct(desthi, destlo, wavelet_type="daubechies",
+                                   order=8, level=1, ext=EXTENSION_PERIODIC):
+    """Inverse stationary WT step at ``level`` -> full-length src.
+
+    Beyond-parity (see wavelet_reconstruct). The a-trous analysis operator
+    pair satisfies A_lo^T A_lo + A_hi^T A_hi = 2c I, so
+
+        x[m] = (1/(2c)) * sum_j f_lo[j]*lo[m - s*j] + f_hi[j]*hi[m - s*j]
+
+    with s = 2^(level-1), indices mod n, c = sum(f_lo^2). Periodic only.
+    """
+    if ext != EXTENSION_PERIODIC:
+        raise ValueError("reconstruction requires ext='periodic' "
+                         "(other modes discard boundary information)")
+    if level < 1:
+        raise ValueError("level must be >= 1")
+    hi = np.asarray(desthi, dtype=np.float64)
+    lo = np.asarray(destlo, dtype=np.float64)
+    if hi.shape != lo.shape:
+        raise ValueError("desthi/destlo shapes differ")
+    n = hi.shape[-1]
+    stride = 1 << (level - 1)
+    hi_f, lo_f = wavelet_data.highpass_lowpass(wavelet_type, order, np.float64)
+    gain = 1.0 / (2.0 * np.sum(lo_f * lo_f))
+    m = np.arange(n)
+    out = np.zeros(hi.shape[:-1] + (n,))
+    for j in range(order):
+        idx = (m - stride * j) % n
+        out = out + lo_f[j] * lo[..., idx] + hi_f[j] * hi[..., idx]
+    return out * gain
