@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Matched-filter detection demo.
+
+    python examples/matched_filter.py
+
+Hides two pulse templates in noise at known offsets and recovers their
+positions with the template-bank matched filter (one fused correlation
+pass over the bank, top-k scored peaks).
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from veles.simd_tpu.models import MatchedFilterDetector
+
+    n, m = 8192, 63
+    rng = np.random.default_rng(1)
+    bank = np.stack([
+        np.hanning(m),
+        np.sin(np.linspace(0, 6 * np.pi, m)) * np.hanning(m),
+    ]).astype(np.float32)
+
+    sig = 0.2 * rng.normal(size=n).astype(np.float32)
+    truth = {0: [1200, 5000], 1: [3000]}
+    for k, offs in truth.items():
+        for o in offs:
+            sig[o:o + m] += bank[k]
+
+    det = MatchedFilterDetector(bank, capacity=4, normalize=False)
+    scores, lags, values, counts = det(sig[None])
+
+    for k in range(bank.shape[0]):
+        found = sorted(int(p) for p, v in
+                       zip(np.asarray(lags[0, k]), np.asarray(values[0, k]))
+                       if v > 0.7 * float(values[0, k].max()))
+        print(f"template {k}: injected at {truth[k]}, detected at {found}")
+
+
+if __name__ == "__main__":
+    main()

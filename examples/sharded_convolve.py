@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Sequence-parallel convolution demo on a virtual 8-device mesh.
+
+    python examples/sharded_convolve.py
+
+Shards a long signal over 8 (virtual CPU) devices, convolves it with a
+halo exchange over the mesh — the distributed form of overlap-save — and
+checks the result against the single-device op. The exact same code runs
+on a real v5e-8 slice (the mesh axes ride ICI instead of host memory).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import ops, parallel
+
+    mesh = parallel.make_mesh({"seq": 8})
+    n, m = 1 << 16, 127
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray((rng.normal(size=m) / m).astype(np.float32))
+
+    sharded = parallel.convolve_sharded(x, h, mesh, boundary="zero")
+    single = ops.convolve(x, h)[:n]
+
+    err = float(jnp.max(jnp.abs(sharded - single)))
+    print(f"devices: {jax.device_count()}, mesh: {dict(mesh.shape)}")
+    print(f"max |sharded - single-device| = {err:.2e}")
+    assert err < 1e-4
+
+
+if __name__ == "__main__":
+    main()
