@@ -108,3 +108,55 @@ class TestContracts:
     def test_batch_trim_rejected(self):
         with pytest.raises(ValueError):
             D.detect_peaks(np.zeros((2, 8), np.float32), impl="xla")
+
+
+class TestTopK:
+    def test_ranks_maxima_by_height(self, rng):
+        from veles.simd_tpu import ops
+
+        x = np.zeros(64, np.float32)
+        for pos, h in [(10, 3.0), (30, 5.0), (50, 1.0)]:
+            x[pos] = h
+        pos, val, count = ops.detect_peaks_topk(
+            x, ops.EXTREMUM_TYPE_MAXIMUM, k=2, impl="xla")
+        assert count == 2  # 3 peaks found, clipped to k
+        assert list(np.asarray(pos)) == [30, 10]
+        np.testing.assert_allclose(np.asarray(val), [5.0, 3.0])
+
+    def test_both_ranks_by_abs(self, rng):
+        from veles.simd_tpu import ops
+
+        x = np.zeros(64, np.float32)
+        x[10] = 2.0
+        x[40] = -6.0
+        pos, val, count = ops.detect_peaks_topk(x, k=2, impl="xla")
+        assert list(np.asarray(pos)) == [40, 10]
+
+    def test_matches_reference(self, rng):
+        from veles.simd_tpu import ops
+
+        x = rng.normal(size=200).astype(np.float32)
+        for et in (1, 2, 3):
+            pr, vr, cr = ops.detect_peaks_topk(x, et, k=8, impl="reference")
+            px, vx, cx = ops.detect_peaks_topk(x, et, k=8, impl="xla")
+            assert cr == int(cx)
+            np.testing.assert_array_equal(pr, np.asarray(px))
+            np.testing.assert_allclose(vr, np.asarray(vx), atol=1e-6)
+
+    def test_batched_and_padding(self, rng):
+        from veles.simd_tpu import ops
+
+        x = rng.normal(size=(4, 100)).astype(np.float32)
+        pos, val, count = ops.detect_peaks_topk(x, k=60, impl="xla")
+        assert pos.shape == (4, 60)
+        for b in range(4):
+            c = int(count[b])
+            assert (np.asarray(pos[b])[c:] == -1).all()
+
+    def test_validation(self, rng):
+        from veles.simd_tpu import ops
+
+        with pytest.raises(ValueError):
+            ops.detect_peaks_topk(np.zeros(2, np.float32), k=1)
+        with pytest.raises(ValueError):
+            ops.detect_peaks_topk(np.zeros(10, np.float32), k=0)

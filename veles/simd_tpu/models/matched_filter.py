@@ -33,22 +33,14 @@ def _detect(signals, templates, capacity, normalize):
     acc = jnp.zeros(x.shape[:-1] + (k, n_out), jnp.float32)
     for j in range(m):
         acc = acc + pad[..., None, j:j + n_out] * templates[:, j, None]
-    # Top-scoring local maxima per (signal, template). This differs from
-    # ops.detect_peaks_fixed deliberately: the API-parity op keeps the
-    # FIRST `capacity` peaks in position order (the reference's array
-    # semantics); a matched filter wants the strongest ones, so mask
-    # non-peaks to -inf and top_k by score.
-    d1 = acc[..., 1:-1] - acc[..., :-2]
-    d2 = acc[..., 1:-1] - acc[..., 2:]
-    is_peak = (d1 * d2 > 0) & (d1 > 0)
-    masked = jnp.where(is_peak, acc[..., 1:-1], -jnp.inf)
-    values, idx = jax.lax.top_k(masked, capacity)
-    valid = jnp.isfinite(values)
-    # idx+1 indexes the padded 'full' correlation; shift to template-start
-    # lags in [-(m-1), n-1]
-    positions = jnp.where(valid, idx + 1 - (m - 1), -(n_out + 1))
-    values = jnp.where(valid, values, 0.0)
-    count = jnp.minimum(jnp.sum(is_peak, axis=-1), capacity).astype(jnp.int32)
+    # Strongest peaks per (signal, template) — detect_peaks_topk ranks by
+    # height (ops.detect_peaks_fixed would keep the first `capacity` in
+    # position order instead, the reference's array semantics).
+    positions, values, count = ops.detect_peaks_topk(
+        acc, ops.EXTREMUM_TYPE_MAXIMUM, k=capacity, impl="xla")
+    # positions index the padded 'full' correlation; shift to
+    # template-start lags in [-(m-1), n-1], invalid slots below range
+    positions = jnp.where(positions >= 0, positions - (m - 1), -(n_out + 1))
     return acc, positions, values, count
 
 

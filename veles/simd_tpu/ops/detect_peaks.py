@@ -116,6 +116,68 @@ def detect_peaks_fixed(data, extremum_type=EXTREMUM_TYPE_BOTH, *,
     return _detect_peaks_fixed_xla(data, int(extremum_type), int(capacity))
 
 
+@functools.partial(jax.jit, static_argnames=("extremum_type", "k"))
+def _detect_peaks_topk_xla(data, extremum_type, k):
+    data = jnp.asarray(data, jnp.float32)
+    d1 = data[..., 1:-1] - data[..., :-2]
+    d2 = data[..., 1:-1] - data[..., 2:]
+    strict = d1 * d2 > 0
+    sel = jnp.zeros_like(strict)
+    if extremum_type & EXTREMUM_TYPE_MAXIMUM:
+        sel = sel | (strict & (d1 > 0))
+    if extremum_type & EXTREMUM_TYPE_MINIMUM:
+        sel = sel | (strict & (d1 < 0))
+    # rank maxima by value, minima by depth: top_k over |pairwise| key
+    key = data[..., 1:-1]
+    if extremum_type == EXTREMUM_TYPE_MINIMUM:
+        key = -key
+    elif extremum_type == EXTREMUM_TYPE_BOTH:
+        key = jnp.abs(key)
+    masked = jnp.where(sel, key, -jnp.inf)
+    kv, idx = jax.lax.top_k(masked, k)
+    valid = jnp.isfinite(kv)
+    positions = jnp.where(valid, idx + 1, -1).astype(jnp.int32)
+    values = jnp.take_along_axis(data, jnp.clip(positions, 0), axis=-1)
+    values = jnp.where(valid, values, 0).astype(jnp.float32)
+    count = jnp.minimum(jnp.sum(sel, axis=-1), k).astype(jnp.int32)
+    return positions, values, count
+
+
+def detect_peaks_topk(data, extremum_type=EXTREMUM_TYPE_BOTH, *, k,
+                      impl=None):
+    """Strongest-``k`` peaks -> (positions, values, count).
+
+    Companion to detect_peaks_fixed, which keeps the FIRST ``capacity``
+    peaks in position order (the reference's array semantics,
+    detect_peaks.c:58-127). This one ranks: maxima by height, minima by
+    depth, BOTH by |value| — what matched filtering and sparse event
+    extraction actually want. Positions come back in rank order, -1
+    padded; batch dims supported.
+    """
+    impl = resolve_impl(impl)
+    data = np.asarray(data) if impl == "reference" else jnp.asarray(data)
+    n = data.shape[-1]
+    if n <= 2:
+        raise ValueError("size must be > 2 (detect_peaks.c:67)")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    k = min(int(k), n - 2)
+    if impl == "reference":
+        if data.ndim != 1:
+            raise ValueError("reference impl is 1-D (the C API shape)")
+        pos, val = _ref.detect_peaks(data, extremum_type)
+        key = np.abs(val) if extremum_type == EXTREMUM_TYPE_BOTH else (
+            val if extremum_type == EXTREMUM_TYPE_MAXIMUM else -val)
+        order = np.argsort(-key, kind="stable")[:k]
+        count = min(len(pos), k)
+        positions = np.full(k, -1, np.int32)
+        values = np.zeros(k, np.float32)
+        positions[:count] = pos[order][:count]
+        values[:count] = val[order][:count]
+        return positions, values, np.int32(count)
+    return _detect_peaks_topk_xla(data, int(extremum_type), k)
+
+
 def detect_peaks(data, extremum_type=EXTREMUM_TYPE_BOTH, *, impl=None):
     """API-parity form -> (positions, values) trimmed to the found count
     (the reference's ExtremumPoint array, detect_peaks.c:58-127)."""
