@@ -137,3 +137,50 @@ class TestHaloContracts:
     def test_bad_boundary_rejected(self, mesh):
         with pytest.raises(ValueError):
             parallel.halo_map(lambda x: x, mesh, boundary="mirror")
+
+
+class TestShardedDecompose:
+    def test_dwt_cascade_matches_single_device(self, rng):
+        import jax.numpy as jnp
+
+        from veles.simd_tpu import ops, parallel
+
+        mesh = parallel.make_mesh({"seq": 8})
+        x = rng.normal(size=1024).astype(np.float32)
+        details_s, approx_s = parallel.wavelet_decompose_sharded(
+            jnp.asarray(x), 3, "daubechies", 8, "periodic", mesh=mesh)
+        details, approx = ops.wavelet_decompose(x, 3, "daubechies", 8,
+                                                "periodic", impl="xla")
+        np.testing.assert_allclose(np.asarray(approx_s), np.asarray(approx),
+                                   atol=2e-4)
+        for ds, d in zip(details_s, details):
+            np.testing.assert_allclose(np.asarray(ds), np.asarray(d),
+                                       atol=2e-4)
+
+    def test_swt_cascade_matches_single_device(self, rng):
+        import jax.numpy as jnp
+
+        from veles.simd_tpu import ops, parallel
+
+        mesh = parallel.make_mesh({"seq": 8})
+        x = rng.normal(size=512).astype(np.float32)
+        details_s, approx_s = parallel.stationary_wavelet_decompose_sharded(
+            jnp.asarray(x), 3, "daubechies", 8, "periodic", mesh=mesh)
+        details, approx = ops.stationary_wavelet_decompose(
+            x, 3, "daubechies", 8, "periodic", impl="xla")
+        np.testing.assert_allclose(np.asarray(approx_s), np.asarray(approx),
+                                   atol=2e-4)
+        for ds, d in zip(details_s, details):
+            np.testing.assert_allclose(np.asarray(ds), np.asarray(d),
+                                       atol=2e-4)
+
+    def test_depth_validation(self, rng):
+        from veles.simd_tpu import parallel
+
+        mesh = parallel.make_mesh({"seq": 8})
+        with pytest.raises(ValueError, match="divisible"):
+            parallel.wavelet_decompose_sharded(
+                np.zeros(128, np.float32), 5, mesh=mesh)
+        with pytest.raises(ValueError, match=">= 1"):
+            parallel.stationary_wavelet_decompose_sharded(
+                np.zeros(128, np.float32), 0, mesh=mesh)

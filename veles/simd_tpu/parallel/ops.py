@@ -144,3 +144,48 @@ def batch_map(fn, mesh, axis="data", *, n_broadcast_args=0):
         local, mesh=mesh,
         in_specs=(P(axis),) + (P(),) * n_broadcast_args,
         out_specs=P(axis))
+
+
+def wavelet_decompose_sharded(x, levels, wavelet_type="daubechies", order=8,
+                              ext=EXTENSION_PERIODIC, *, mesh, axis="seq"):
+    """Multi-level sequence-parallel DWT -> (details, approx).
+
+    The sharded twin of ops.wavelet_decompose: each level's lowpass feeds
+    the next level's sharded step, halving per-device work; the halo
+    exchange stays order samples per level regardless of depth. Requires
+    n / 2^levels to still split into even-length shards.
+    """
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n % (n_shards * (1 << levels)) != 0:
+        raise ValueError(
+            f"length {n} must keep even-length shards across {n_shards} "
+            f"devices for all {levels} levels "
+            f"(divisible by shards * 2^levels = {n_shards * (1 << levels)})")
+    details = []
+    lo = x
+    for _ in range(levels):
+        hi, lo = wavelet_apply_sharded(lo, wavelet_type, order, ext,
+                                       mesh=mesh, axis=axis)
+        details.append(hi)
+    return details, lo
+
+
+def stationary_wavelet_decompose_sharded(x, levels,
+                                         wavelet_type="daubechies", order=8,
+                                         ext=EXTENSION_PERIODIC, *, mesh,
+                                         axis="seq"):
+    """Multi-level sequence-parallel SWT -> (details, approx); level k
+    exchanges an order * 2^(k-1) sample halo (the dilated filter span)."""
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    details = []
+    lo = jnp.asarray(x, jnp.float32)
+    for level in range(1, levels + 1):
+        hi, lo = stationary_wavelet_apply_sharded(
+            lo, wavelet_type, order, level, ext, mesh=mesh, axis=axis)
+        details.append(hi)
+    return details, lo
