@@ -1,0 +1,68 @@
+"""Checkpoint / restore for array pytrees (orbax-backed).
+
+The reference has nothing to checkpoint — its handles are in-memory FFT
+plans (SURVEY §5: "no checkpointing of progress"). A TPU framework
+accumulates state worth keeping: model weights (models.SignalPipeline
+heads), precomputed filter spectra, denoiser thresholds. This module is
+the thin, dependency-gated wrapper: a pytree of arrays in, a directory
+out, restore onto any device/sharding.
+
+    from veles.simd_tpu.utils import checkpoint
+    checkpoint.save("/path/ckpt", {"w": w, "fir": fir})
+    state = checkpoint.restore("/path/ckpt")
+
+Orbax is the storage engine (multi-host safe, atomic renames); falls back
+to a plain .npz when orbax is unavailable so the API works everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except Exception:
+        return None
+
+
+def save(path: str, tree, *, force: bool = True) -> str:
+    """Write a pytree of arrays to ``path`` (a directory). Returns path."""
+    path = os.path.abspath(str(path))
+    ocp = _orbax()
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, tree, force=force)
+        return path
+    # fallback: flatten to npz (no sharding metadata)
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    os.makedirs(path, exist_ok=True)
+    np.savez(os.path.join(path, "state.npz"),
+             treedef=np.frombuffer(repr(treedef).encode(), dtype=np.uint8),
+             **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+    return path
+
+
+def restore(path: str, *, target=None):
+    """Read a pytree written by ``save``. ``target`` (optional) provides
+    structure/shardings to restore onto (orbax restore_args semantics:
+    a pytree of like-shaped arrays)."""
+    path = os.path.abspath(str(path))
+    ocp = _orbax()
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        if target is not None:
+            return ckptr.restore(path, item=target)
+        return ckptr.restore(path)
+    import jax
+    with np.load(os.path.join(path, "state.npz")) as z:
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    if target is not None:
+        treedef = jax.tree_util.tree_structure(target)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    return leaves
