@@ -1,0 +1,32 @@
+"""veles.simd_tpu — TPU-native signal-processing framework.
+
+The capabilities of veles.simd (SIMD C library), redesigned for
+JAX/XLA/Pallas on TPU. Subpackages (lazily imported):
+
+  ops       operator families (arithmetic, mathfun, matrix, convolve,
+            correlate, normalize, detect_peaks, wavelet)
+  models    composed pipelines (matched filter, denoiser, flagship)
+  parallel  mesh / halo / sharded ops / multi-host (DCN)
+  host      host runtime: aligned staging, conversions, async feed
+  pallas    hand kernels (VPU/MXU)
+  reference float64 NumPy oracle (the differential-test baseline)
+  utils     benchlib, profiling, speedup, checkpoint
+
+See docs/migration.md for the C-API mapping.
+"""
+
+from veles.simd_tpu._version import __version__  # noqa: F401
+
+_SUBMODULES = ("config", "contracts", "host", "models", "ops", "pallas",
+               "parallel", "reference", "shapes", "utils", "wavelet_data")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+        return importlib.import_module(f"veles.simd_tpu.{name}")
+    raise AttributeError(f"module 'veles.simd_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))
