@@ -225,3 +225,38 @@ class TestContracts:
         assert len(quarters) == 4
         assert all(q.shape == (4,) for q in quarters)
         assert W.wavelet_recycle_source(8, np.zeros(6)) == (None,) * 4
+
+
+class TestWaveletFuzz:
+    """Random (length, order, extension) differential sweeps — short
+    signals, signals shorter than the filter, odd batch shapes."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_dwt_random_shapes(self, seed):
+        rng = np.random.default_rng(3000 + seed)
+        n = 2 * int(rng.integers(1, 300))
+        family = ("daubechies", "symlet", "coiflet")[seed % 3]
+        orders = {"daubechies": (2, 8, 16, 32), "symlet": (4, 10, 24),
+                  "coiflet": (6, 12, 18)}[family]
+        order = int(orders[rng.integers(0, len(orders))])
+        ext = ("periodic", "mirror", "constant", "zero")[seed % 4]
+        x = rng.normal(size=n).astype(np.float32)
+        rh, rl = W.wavelet_apply(x, family, order, ext, impl="reference")
+        xh, xl = W.wavelet_apply(x, family, order, ext, impl="xla")
+        np.testing.assert_allclose(np.asarray(xh), rh, atol=5e-4,
+                                   err_msg=f"{family}{order} n={n} {ext}")
+        np.testing.assert_allclose(np.asarray(xl), rl, atol=5e-4)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_swt_random_shapes(self, seed):
+        rng = np.random.default_rng(4000 + seed)
+        n = int(rng.integers(4, 500))
+        level = int(rng.integers(1, 4))
+        ext = ("periodic", "mirror", "constant", "zero")[seed % 4]
+        x = rng.normal(size=n).astype(np.float32)
+        rh, rl = W.stationary_wavelet_apply(x, "daubechies", 8, level, ext,
+                                              impl="reference")
+        xh, xl = W.stationary_wavelet_apply(x, "daubechies", 8, level, ext,
+                                              impl="xla")
+        np.testing.assert_allclose(np.asarray(xh), rh, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(xl), rl, atol=5e-4)
