@@ -25,6 +25,7 @@ from veles.simd_tpu.parallel.mesh import (  # noqa: F401
 from veles.simd_tpu.parallel.multihost import (  # noqa: F401
     hybrid_mesh, process_info)
 from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
+from veles.simd_tpu.parallel.pipeline import pipeline_map  # noqa: F401
 from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
     convolve_overlap_save_sharded, overlap_save_map)
 from veles.simd_tpu.parallel.ops import (  # noqa: F401
