@@ -137,8 +137,34 @@ def bench_batched_pipeline(scale=1):
             "vs_baseline": None}
 
 
+def bench_flagship(scale=1):
+    """End-to-end SignalPipeline (normalize -> FIR -> SWT -> MXU head):
+    the __graft_entry__ flagship, at benchmark batch size."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu.models import SignalPipeline
+
+    batch, n, k, m = 128, int(4096 * scale), 64, 31
+    rng = np.random.default_rng(0)
+    sig = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+    fir = jnp.asarray(rng.normal(size=m).astype(np.float32))
+    w = jnp.asarray((rng.normal(size=(3 * n, k)) * 0.01).astype(np.float32))
+    pipe = jax.jit(SignalPipeline())
+
+    def step(c):
+        out = pipe(c, fir, w)
+        return c + jnp.float32(1e-9) * jnp.sum(out)
+
+    dt = chain_time(step, sig, iters=1024, null_carry=sig[:1, :8])
+    return {"metric": f"flagship_pipeline_b{batch}_n{n}",
+            "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None}
+
+
 CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
-           bench_batched_pipeline)
+           bench_batched_pipeline, bench_flagship)
 
 
 def run_secondary(stream, scale=None):
