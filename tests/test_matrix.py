@@ -14,6 +14,18 @@ SHAPES = [(4, 4, 4), (8, 8, 8), (99, 35, 77), (1, 7, 1), (16, 128, 256),
           (125, 64, 33)]
 
 
+# The xla impl runs at precision="highest" (full f32 products) -> tight
+# bounds. The pallas kernel runs the MXU's native bf16-product/f32-accum
+# mode BY DESIGN (pallas/matmul.py); on real TPU hardware that is ~2^-8
+# relative per product. The reference's own differential epsilon for this
+# op is 0.1 (tests/matrix.cc:94-98 ASSERT_NEAR) — use it for that path.
+# (On CPU the pallas interpreter computes f32, passing trivially.)
+def _mm_tol(impl):
+    if impl == "xla":
+        return {"rtol": 2e-5, "atol": 2e-4}
+    return {"rtol": 5e-2, "atol": 0.1}
+
+
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 @pytest.mark.parametrize("h1,w1,w2", SHAPES)
 def test_matrix_multiply(impl, h1, w1, w2, rng):
@@ -22,7 +34,7 @@ def test_matrix_multiply(impl, h1, w1, w2, rng):
     ref = ops.matrix_multiply(m1, m2, impl="reference")
     kwargs = {"precision": "highest"} if impl == "xla" else {}
     got = np.asarray(ops.matrix_multiply(m1, m2, impl=impl, **kwargs))
-    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-4)
+    np.testing.assert_allclose(got, ref, **_mm_tol(impl))
 
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
@@ -33,7 +45,7 @@ def test_matrix_multiply_transposed(impl, h1, w1, h2, rng):
     ref = ops.matrix_multiply_transposed(m1, m2, impl="reference")
     kwargs = {"precision": "highest"} if impl == "xla" else {}
     got = np.asarray(ops.matrix_multiply_transposed(m1, m2, impl=impl, **kwargs))
-    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-4)
+    np.testing.assert_allclose(got, ref, **_mm_tol(impl))
     # identity: multiply_transposed(m1, m2) == multiply(m1, m2.T)
     got2 = np.asarray(ops.matrix_multiply(m1, m2.T, impl=impl, **kwargs))
     np.testing.assert_allclose(got, got2, rtol=1e-6, atol=1e-6)
