@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Train the flagship pipeline's linear head with optax.
+
+    python examples/train_head.py
+
+Synthetic task: classify which of two FIR-filtered band signatures a
+noisy signal contains, from the SignalPipeline features. Demonstrates
+the framework composing with the standard JAX training stack (optax,
+value_and_grad, jit) and with checkpoint save/restore.
+"""
+
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from veles.simd_tpu.models import SignalPipeline
+    from veles.simd_tpu.utils import checkpoint
+
+    rng = np.random.default_rng(0)
+    batch, n, m, classes = 64, 256, 15, 2
+
+    def make_batch():
+        labels = rng.integers(0, classes, size=batch)
+        t = np.linspace(0, 1, n)
+        freqs = np.where(labels == 0, 8.0, 21.0)
+        sigs = np.sin(2 * np.pi * freqs[:, None] * t[None, :])
+        sigs = sigs + 0.5 * rng.normal(size=(batch, n))
+        return sigs.astype(np.float32), labels
+
+    pipe = SignalPipeline()
+    fir = jnp.asarray((np.hanning(m) / m).astype(np.float32))
+    w = jnp.asarray((0.01 * rng.normal(size=(3 * n, classes))
+                     ).astype(np.float32))
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(w)
+
+    def loss_fn(w, sig, labels):
+        logits = pipe(sig, fir, w)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    @jax.jit
+    def step(w, opt_state, sig, labels):
+        loss, grad = jax.value_and_grad(loss_fn)(w, sig, labels)
+        updates, opt_state = opt.update(grad, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    for it in range(60):
+        sig, labels = make_batch()
+        w, opt_state, loss = step(w, opt_state, jnp.asarray(sig),
+                                  jnp.asarray(labels))
+        if it % 20 == 0:
+            print(f"step {it:3d}  loss {float(loss):.4f}")
+
+    sig, labels = make_batch()
+    pred = np.argmax(np.asarray(pipe(jnp.asarray(sig), fir, w)), axis=-1)
+    acc = float((pred == labels).mean())
+    print(f"final accuracy: {acc:.2f}")
+    assert acc > 0.9, "training failed to converge"
+
+    with tempfile.TemporaryDirectory() as d:
+        path = checkpoint.save(f"{d}/head", {"w": w, "fir": fir})
+        state = checkpoint.restore(path)
+        print("checkpoint roundtrip ok:",
+              bool(np.allclose(np.asarray(state["w"]), np.asarray(w))))
+
+
+if __name__ == "__main__":
+    main()
