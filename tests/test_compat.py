@@ -1,0 +1,159 @@
+"""C-API compat layer: reference spellings resolve and behave.
+
+The compat namespace must cover SURVEY §2's API-surface checklist with the
+C headers' exact names (enum members included) and route the leading
+``simd`` flag of matrix.h:47 / normalize.h:48 / detect_peaks.h:61 /
+mathfun.h:142 onto the impl switch.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import compat as simd
+
+C_API = """
+malloc_aligned malloc_aligned_offset mallocf memsetf zeropadding
+zeropaddingex rmemcpyf crmemcpyf align_complement_f32 align_complement_i16
+align_complement_i32
+int16_to_float int16_to_int32 int32_to_float int32_to_int16 float_to_int16
+float_to_int32 real_multiply real_multiply_array real_multiply_scalar
+complex_multiply complex_multiply_conjugate complex_conjugate sum_elements
+add_to_all int16_multiply next_highest_power_of_2
+int16_to_float_na int16_to_int32_na int32_to_float_na int32_to_int16_na
+float_to_int16_na float_to_int32_na real_multiply_na real_multiply_array_na
+real_multiply_scalar_na complex_multiply_na complex_multiply_conjugate_na
+complex_conjugate_na sum_elements_na add_to_all_na int16_multiply_na
+sin_psv cos_psv log_psv exp_psv
+matrix_add matrix_sub matrix_multiply matrix_multiply_transposed
+convolve_initialize convolve convolve_finalize convolve_simd
+convolve_fft_initialize convolve_fft convolve_fft_finalize
+convolve_overlap_save_initialize convolve_overlap_save
+convolve_overlap_save_finalize
+cross_correlate_initialize cross_correlate cross_correlate_finalize
+cross_correlate_simd cross_correlate_fft_initialize cross_correlate_fft
+cross_correlate_fft_finalize cross_correlate_overlap_save_initialize
+cross_correlate_overlap_save cross_correlate_overlap_save_finalize
+detect_peaks ExtremumPoint
+normalize2D minmax2D normalize2D_minmax minmax1D
+wavelet_validate_order wavelet_prepare_array wavelet_allocate_destination
+wavelet_recycle_source wavelet_apply wavelet_apply_na
+stationary_wavelet_apply stationary_wavelet_apply_na
+WAVELET_TYPE_DAUBECHIES WAVELET_TYPE_COIFLET WAVELET_TYPE_SYMLET
+EXTENSION_TYPE_PERIODIC EXTENSION_TYPE_MIRROR EXTENSION_TYPE_CONSTANT
+EXTENSION_TYPE_ZERO
+kConvolutionAlgorithmBruteForce kConvolutionAlgorithmFFT
+kConvolutionAlgorithmOverlapSave
+kExtremumTypeMaximum kExtremumTypeMinimum kExtremumTypeBoth
+""".split()
+
+
+def test_every_c_symbol_present():
+    missing = [n for n in C_API if not hasattr(simd, n)]
+    assert not missing, missing
+    assert set(C_API) <= set(simd.__all__)
+
+
+def test_extremum_enum_values_match_c():
+    # detect_peaks.h:41-43: Maximum = 1, then Minimum, Both (bitmask use)
+    assert simd.kExtremumTypeMaximum == 1
+    assert simd.kExtremumTypeBoth == (
+        simd.kExtremumTypeMaximum | simd.kExtremumTypeMinimum)
+
+
+def test_simd_flag_routes_impl():
+    x = np.linspace(0.1, 2.0, 64, dtype=np.float32)
+    accel = np.asarray(simd.sin_psv(1, x))
+    oracle = np.asarray(simd.sin_psv(0, x))
+    assert oracle.dtype == np.float64  # the _na path is the float64 oracle
+    np.testing.assert_allclose(accel, np.sin(x), atol=1e-6)
+    np.testing.assert_allclose(oracle, np.sin(x.astype(np.float64)),
+                               atol=1e-12)
+
+
+def test_truthy_flag_stays_accelerated_under_reference_default():
+    # simd=1 must never silently collapse onto the oracle, or differential
+    # checks through the compat flag would compare the oracle to itself
+    from veles.simd_tpu import config
+
+    x = np.linspace(0.1, 1.0, 16, dtype=np.float32)
+    with config.use_impl("reference"):
+        accel = simd.sin_psv(1, x)
+        oracle = simd.sin_psv(0, x)
+        # SIMD kernel names (whose scalar twin is `_na`) likewise stay
+        # accelerated; only an explicit impl= opts out
+        pair_accel = simd.real_multiply(x, x)
+        pair_oracle = simd.real_multiply_na(x, x)
+        wa_hi, _ = simd.wavelet_apply(np.tile(x, 8))
+    assert np.asarray(accel).dtype == np.float32
+    assert np.asarray(oracle).dtype == np.float64
+    assert np.asarray(pair_accel).dtype == np.float32
+    assert np.asarray(pair_oracle).dtype == np.float64
+    assert np.asarray(wa_hi).dtype == np.float32
+
+
+def test_matrix_multiply_both_flags():
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(5, 7)).astype(np.float32)
+    b = rng.normal(size=(7, 4)).astype(np.float32)
+    for flag in (0, 1):
+        np.testing.assert_allclose(
+            np.asarray(simd.matrix_multiply(flag, a, b)), a @ b, atol=1e-4)
+
+
+def test_convolve_handle_family():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=300).astype(np.float32)
+    h = rng.normal(size=16).astype(np.float32)
+    want = np.convolve(x, h)
+    for init in (simd.convolve_initialize,
+                 simd.convolve_fft_initialize,
+                 simd.convolve_overlap_save_initialize):
+        handle = init(len(x), len(h))
+        np.testing.assert_allclose(np.asarray(handle(x, h)), want, atol=1e-3)
+        simd.convolve_finalize(handle)
+
+
+def test_cross_correlate_reversed_handles():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=256).astype(np.float32)
+    h = rng.normal(size=12).astype(np.float32)
+    want = np.convolve(x, h[::-1])
+    for init in (simd.cross_correlate_fft_initialize,
+                 simd.cross_correlate_overlap_save_initialize):
+        handle = init(len(x), len(h))
+        assert handle.reverse
+        np.testing.assert_allclose(np.asarray(handle(x, h)), want, atol=1e-3)
+
+
+def test_detect_peaks_returns_extremum_points():
+    t = np.arange(1000, dtype=np.float32)
+    data = np.sin(2 * np.pi * t / 200).astype(np.float32)
+    pts = simd.detect_peaks(1, data, simd.kExtremumTypeMaximum)
+    assert pts and all(isinstance(p, simd.ExtremumPoint) for p in pts)
+    for p in pts:
+        assert data[p.position] >= data[p.position - 1]
+        assert data[p.position] >= data[p.position + 1]
+        assert p.value == pytest.approx(float(data[p.position]))
+
+
+def test_wavelet_na_twin_is_oracle():
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=128).astype(np.float32)
+    hi, lo = simd.wavelet_apply(x, simd.WAVELET_TYPE_DAUBECHIES, 8,
+                                ext=simd.EXTENSION_TYPE_PERIODIC)
+    hi_na, lo_na = simd.wavelet_apply_na(x, simd.WAVELET_TYPE_DAUBECHIES, 8,
+                                         ext=simd.EXTENSION_TYPE_PERIODIC)
+    np.testing.assert_allclose(np.asarray(hi), hi_na, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(lo), lo_na, atol=5e-4)
+
+
+def test_normalize_family_flags():
+    rng = np.random.default_rng(11)
+    img = rng.integers(0, 256, size=(16, 32)).astype(np.uint8)
+    out0 = np.asarray(simd.normalize2D(0, img))
+    out1 = np.asarray(simd.normalize2D(1, img))
+    np.testing.assert_allclose(out1, out0, atol=1e-6)
+    assert out1.min() == pytest.approx(-1.0, abs=1e-6)
+    assert out1.max() == pytest.approx(1.0, abs=1e-6)
+    vmin, vmax = simd.minmax2D(1, img)
+    assert (int(vmin), int(vmax)) == (int(img.min()), int(img.max()))

@@ -17,8 +17,9 @@ See docs/migration.md for the C-API mapping.
 
 from veles.simd_tpu._version import __version__  # noqa: F401
 
-_SUBMODULES = ("config", "contracts", "host", "models", "ops", "pallas",
-               "parallel", "reference", "shapes", "utils", "wavelet_data")
+_SUBMODULES = ("compat", "config", "contracts", "host", "models", "ops",
+               "pallas", "parallel", "reference", "shapes", "utils",
+               "wavelet_data")
 
 
 def __getattr__(name):

@@ -1,0 +1,235 @@
+"""C-API compatibility layer — the reference's exact symbol names.
+
+One flat namespace spelling every public symbol of the reference C API the
+way the C headers spell it (enum members included), so a migrating user can
+``from veles.simd_tpu import compat as simd`` and keep their call sites
+recognizable. Two deliberate signature adaptations, per docs/migration.md:
+
+* out-pointers become return values (arrays in, arrays out);
+* the ops that take a leading ``int simd`` flag in C (matrix.h:47,
+  normalize.h:48, detect_peaks.h:61, mathfun.h:142) keep it here as a
+  leading truthy flag mapped onto ``impl=`` ("reference" when falsy, the
+  configured accelerated impl when truthy).
+
+Everything else is a direct alias of the canonical API in ``ops``/``host``/
+``shapes``; ``_na`` twins (arithmetic-inl.h:981-998, wavelet.h:120-162) are
+the float64 oracle (``impl="reference"``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+from veles.simd_tpu import host as _host
+from veles.simd_tpu import ops as _ops
+from veles.simd_tpu import shapes as _shapes
+from veles.simd_tpu.config import resolve_impl as _resolve_impl
+
+# ---------------------------------------------------------------------------
+# enums, spelled as the C headers spell them
+# ---------------------------------------------------------------------------
+
+# WaveletType (wavelet_types.h:38-42)
+WAVELET_TYPE_DAUBECHIES = "daubechies"
+WAVELET_TYPE_COIFLET = "coiflet"
+WAVELET_TYPE_SYMLET = "symlet"
+
+# ExtensionType (wavelet_types.h:44-53)
+EXTENSION_TYPE_PERIODIC = _ops.EXTENSION_PERIODIC
+EXTENSION_TYPE_MIRROR = _ops.EXTENSION_MIRROR
+EXTENSION_TYPE_CONSTANT = _ops.EXTENSION_CONSTANT
+EXTENSION_TYPE_ZERO = _ops.EXTENSION_ZERO
+
+# ConvolutionAlgorithm (convolve_structs.h:60-64)
+kConvolutionAlgorithmBruteForce = "direct"
+kConvolutionAlgorithmFFT = "fft"
+kConvolutionAlgorithmOverlapSave = "overlap_save"
+
+# ExtremumType (detect_peaks.h:40-44)
+kExtremumTypeMaximum = _ops.EXTREMUM_TYPE_MAXIMUM
+kExtremumTypeMinimum = _ops.EXTREMUM_TYPE_MINIMUM
+kExtremumTypeBoth = _ops.EXTREMUM_TYPE_BOTH
+
+
+class ExtremumPoint(NamedTuple):
+    """detect_peaks.h:46-49."""
+
+    position: int
+    value: float
+
+
+def _impl_from_simd(simd):
+    if not simd:
+        return "reference"
+    impl = _resolve_impl(None)
+    # A truthy C flag always means the accelerated path, even when the
+    # ambient configured impl is the oracle — otherwise simd=1 vs simd=0
+    # differential checks would compare the oracle against itself.
+    return "xla" if impl == "reference" else impl
+
+
+def _with_simd_flag(fn):
+    """C's leading ``int simd`` argument -> the impl switch."""
+
+    @functools.wraps(fn)
+    def wrapped(simd, *args, **kwargs):
+        return fn(*args, impl=_impl_from_simd(simd), **kwargs)
+
+    return wrapped
+
+
+def _accelerated(fn):
+    """A C SIMD kernel name always means the accelerated path (its scalar
+    counterpart is the ``_na`` twin), so an ambient ``use_impl("reference")``
+    must not collapse the pair onto the same oracle; an explicit ``impl=``
+    still wins."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, impl=None, **kwargs):
+        return fn(*args, impl=impl if impl else _impl_from_simd(1), **kwargs)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# memory.h (host layer; malloc_aligned memory.c:69, memsetf :85, ...)
+# ---------------------------------------------------------------------------
+
+malloc_aligned = _host.malloc_aligned
+malloc_aligned_offset = _host.malloc_aligned_offset
+mallocf = _host.mallocf
+memsetf = _host.memsetf
+zeropadding = _host.zeropadding
+zeropaddingex = _host.zeropaddingex
+rmemcpyf = _host.rmemcpyf
+crmemcpyf = _host.crmemcpyf
+align_complement_f32 = _host.align_complement_f32
+align_complement_i16 = _host.align_complement_i16
+align_complement_i32 = _host.align_complement_i32
+
+# ---------------------------------------------------------------------------
+# arithmetic-inl.h — SIMD name = accelerated, `_na` twin = oracle (:981-998)
+# ---------------------------------------------------------------------------
+
+next_highest_power_of_2 = _shapes.next_highest_power_of_2
+
+_NA_KERNELS = (
+    "int16_to_float", "int16_to_int32", "int32_to_float", "int32_to_int16",
+    "float_to_int16", "float_to_int32", "real_multiply",
+    "real_multiply_array", "real_multiply_scalar", "complex_multiply",
+    "complex_multiply_conjugate", "complex_conjugate", "sum_elements",
+    "add_to_all", "int16_multiply",
+)
+for _name in _NA_KERNELS:
+    globals()[_name] = _accelerated(getattr(_ops, _name))
+    globals()[_name + "_na"] = functools.partial(
+        getattr(_ops, _name), impl="reference")
+del _name
+
+# ---------------------------------------------------------------------------
+# mathfun.h:142-204 — sin_psv(simd, src, length, res) -> sin_psv(simd, src)
+# ---------------------------------------------------------------------------
+
+sin_psv = _with_simd_flag(_ops.sin_psv)
+cos_psv = _with_simd_flag(_ops.cos_psv)
+log_psv = _with_simd_flag(_ops.log_psv)
+exp_psv = _with_simd_flag(_ops.exp_psv)
+
+# ---------------------------------------------------------------------------
+# matrix.h:47-89 — matrix_add(simd, m1, m2, w, h, res) -> (simd, m1, m2)
+# ---------------------------------------------------------------------------
+
+matrix_add = _with_simd_flag(_ops.matrix_add)
+matrix_sub = _with_simd_flag(_ops.matrix_sub)
+matrix_multiply = _with_simd_flag(_ops.matrix_multiply)
+matrix_multiply_transposed = _with_simd_flag(_ops.matrix_multiply_transposed)
+
+# ---------------------------------------------------------------------------
+# convolve.h:41-125 / correlate.h:41-135 — the 3x3 handle families
+# ---------------------------------------------------------------------------
+
+ConvolutionHandle = _ops.ConvolutionHandle
+convolve_initialize = _ops.convolve_initialize
+convolve = _ops.convolve
+convolve_finalize = _ops.convolve_finalize
+convolve_simd = _ops.convolve_simd
+
+
+def convolve_fft_initialize(x_length, h_length):
+    return _ops.convolve_initialize(x_length, h_length, algorithm="fft")
+
+
+def convolve_overlap_save_initialize(x_length, h_length):
+    return _ops.convolve_initialize(x_length, h_length,
+                                    algorithm="overlap_save")
+
+
+convolve_fft = _ops.convolve_fft
+convolve_fft_finalize = _ops.convolve_finalize
+convolve_overlap_save = _ops.convolve_overlap_save
+convolve_overlap_save_finalize = _ops.convolve_finalize
+
+cross_correlate_initialize = _ops.cross_correlate_initialize
+cross_correlate = _ops.cross_correlate
+cross_correlate_finalize = _ops.cross_correlate_finalize
+cross_correlate_simd = _ops.cross_correlate_simd
+
+
+def cross_correlate_fft_initialize(x_length, h_length):
+    return _ops.cross_correlate_initialize(x_length, h_length,
+                                           algorithm="fft")
+
+
+def cross_correlate_overlap_save_initialize(x_length, h_length):
+    return _ops.cross_correlate_initialize(x_length, h_length,
+                                           algorithm="overlap_save")
+
+
+cross_correlate_fft = _ops.cross_correlate_fft
+cross_correlate_fft_finalize = _ops.cross_correlate_finalize
+cross_correlate_overlap_save = _ops.cross_correlate_overlap_save
+cross_correlate_overlap_save_finalize = _ops.cross_correlate_finalize
+
+# ---------------------------------------------------------------------------
+# detect_peaks.h:51-63 — results array of ExtremumPoint
+# ---------------------------------------------------------------------------
+
+
+def detect_peaks(simd, data, extremum_type=kExtremumTypeBoth):
+    """detect_peaks(simd, src, size, type, **results, *count) reborn:
+    returns a list of ExtremumPoint (the realloc-grown output array,
+    detect_peaks.c:30-39, as a host-side list)."""
+    pos, val = _ops.detect_peaks(data, extremum_type,
+                                 impl=_impl_from_simd(simd))
+    return [ExtremumPoint(int(p), float(v)) for p, v in zip(pos, val)]
+
+
+# ---------------------------------------------------------------------------
+# normalize.h:48-90
+# ---------------------------------------------------------------------------
+
+normalize2D = _with_simd_flag(_ops.normalize2D)
+minmax2D = _with_simd_flag(_ops.minmax2D)
+minmax1D = _with_simd_flag(_ops.minmax1D)
+normalize2D_minmax = _with_simd_flag(_ops.normalize2D_minmax)
+
+
+# ---------------------------------------------------------------------------
+# wavelet.h:45-162
+# ---------------------------------------------------------------------------
+
+wavelet_validate_order = _ops.wavelet_validate_order
+wavelet_prepare_array = _ops.wavelet_prepare_array
+wavelet_allocate_destination = _ops.wavelet_allocate_destination
+wavelet_recycle_source = _ops.wavelet_recycle_source
+wavelet_apply = _accelerated(_ops.wavelet_apply)
+wavelet_apply_na = functools.partial(_ops.wavelet_apply, impl="reference")
+stationary_wavelet_apply = _accelerated(_ops.stationary_wavelet_apply)
+stationary_wavelet_apply_na = functools.partial(
+    _ops.stationary_wavelet_apply, impl="reference")
+
+__all__ = sorted(
+    n for n in globals()
+    if not n.startswith("_") and n not in
+    {"annotations", "functools", "NamedTuple"})
