@@ -1,0 +1,149 @@
+"""alltoall_map (Ulysses-style sequence<->batch resharding) differential
+tests on the 8-device mesh: the sharded whole-signal ops must match their
+single-device twins exactly (same XLA ops, just resharded)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops, parallel
+from veles.simd_tpu.parallel.alltoall import alltoall_map
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.make_mesh({"seq": 8})
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    return parallel.make_mesh({"data": 2, "seq": 4})
+
+
+def _signals(batch=16, n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    base = np.sin(np.linspace(0, 40 * np.pi, n, dtype=np.float32))
+    return (base[None, :] * rng.uniform(0.5, 2.0, (batch, 1))
+            + rng.normal(scale=0.05, size=(batch, n))).astype(np.float32)
+
+
+def test_roundtrip_identity(mesh):
+    x = _signals()
+    fn = alltoall_map(lambda sig: sig, mesh, "seq")
+    np.testing.assert_array_equal(np.asarray(fn(x)), x)
+
+
+def test_whole_signals_seen_locally(mesh):
+    # the local fn must observe COMPLETE signals: a global per-signal
+    # reduction broadcast back over the row is only correct if so
+    x = _signals()
+    fn = alltoall_map(
+        lambda sig: jnp.broadcast_to(
+            jnp.sum(sig, axis=-1, keepdims=True), sig.shape),
+        mesh, "seq")
+    # float32 row sums sit near zero (20 sine periods cancel), so compare
+    # absolutely at float32 reduction-order noise scale
+    want = np.broadcast_to(
+        x.astype(np.float64).sum(axis=-1, keepdims=True), x.shape)
+    np.testing.assert_allclose(np.asarray(fn(x)), want, atol=1e-3)
+
+
+def test_broadcast_args(mesh):
+    x = _signals()
+    taps = np.arange(4, dtype=np.float32)
+    fn = alltoall_map(lambda sig, t: sig * jnp.sum(t), mesh, "seq",
+                      n_broadcast_args=1)
+    np.testing.assert_allclose(np.asarray(fn(x, taps)), x * taps.sum(),
+                               rtol=1e-6)
+
+
+def test_normalize1D_sharded_matches_single_device(mesh):
+    x = _signals()
+    got = np.asarray(parallel.normalize1D_sharded(x, mesh=mesh))
+    want = np.asarray(ops.normalize1D(x, impl="xla"))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert got.min() == pytest.approx(-1.0, abs=1e-6)
+
+
+def test_minmax1D_sharded_matches_single_device(mesh):
+    x = _signals()
+    vmin, vmax = parallel.minmax1D_sharded(x, mesh=mesh)
+    wmin, wmax = ops.minmax1D(x, impl="xla")
+    np.testing.assert_allclose(np.asarray(vmin), np.asarray(wmin), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vmax), np.asarray(wmax), rtol=1e-6)
+
+
+def test_detect_peaks_fixed_sharded_global_positions(mesh):
+    x = _signals()
+    pos, val, cnt = parallel.detect_peaks_fixed_sharded(
+        x, capacity=64, mesh=mesh)
+    wpos, wval, wcnt = ops.detect_peaks_fixed(x, capacity=64, impl="xla")
+    np.testing.assert_array_equal(np.asarray(pos), np.asarray(wpos))
+    np.testing.assert_allclose(np.asarray(val), np.asarray(wval), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(wcnt))
+    # positions are global indices into the full-length signal
+    assert np.asarray(pos).max() > x.shape[-1] // 8
+
+
+def test_mirror_extension_wavelet_through_alltoall(mesh):
+    # halo_map refuses mirror extension (needs the far ends); the layout
+    # swap makes it just work on whole signals
+    x = _signals(batch=8, n=256)
+    fn = alltoall_map(
+        lambda sig: jnp.concatenate(
+            ops.wavelet_apply(sig, "daubechies", 8, ext="mirror",
+                              impl="xla"), axis=-1),
+        mesh, "seq", out="batch")
+    got = np.asarray(fn(x))
+    hi, lo = ops.wavelet_apply(x, "daubechies", 8, ext="mirror", impl="xla")
+    np.testing.assert_allclose(got, np.concatenate([hi, lo], axis=-1),
+                               atol=1e-5)
+
+
+def test_works_on_2d_mesh_axis(mesh2d):
+    # resharding over one axis of a dp x sp mesh leaves the other free
+    x = _signals(batch=8, n=256)
+    got = np.asarray(parallel.normalize1D_sharded(x, mesh=mesh2d))
+    want = np.asarray(ops.normalize1D(x, impl="xla"))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_batch_axis_shards_batch_too(mesh2d):
+    # dp x sp: batch sharded over "data", sequence over "seq"; the
+    # all_to_all then swaps only within each data slice
+    x = _signals(batch=16, n=256)
+    fn = alltoall_map(lambda sig: sig * 2.0, mesh2d, "seq",
+                      batch_axis="data")
+    np.testing.assert_allclose(np.asarray(fn(x)), x * 2.0, rtol=1e-6)
+
+    pos, val, cnt = parallel.detect_peaks_fixed_sharded(
+        x, capacity=32, mesh=mesh2d, axis="seq", batch_axis="data")
+    wpos, wval, wcnt = ops.detect_peaks_fixed(x, capacity=32, impl="xla")
+    np.testing.assert_array_equal(np.asarray(pos), np.asarray(wpos))
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(wcnt))
+
+    got = np.asarray(parallel.normalize1D_sharded(
+        x, mesh=mesh2d, batch_axis="data"))
+    np.testing.assert_allclose(
+        got, np.asarray(ops.normalize1D(x, impl="xla")), atol=1e-6)
+
+
+def test_minmax_no_batch_divisibility_constraint(mesh):
+    # the reduction formulation works for any batch size (here 3, not
+    # divisible by 8 devices) — only the sequence axis must split
+    x = _signals(batch=3, n=512)
+    vmin, vmax = parallel.minmax1D_sharded(x, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(vmin), x.min(axis=-1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vmax), x.max(axis=-1), rtol=1e-6)
+
+
+def test_shape_validation(mesh):
+    fn = alltoall_map(lambda sig: sig, mesh, "seq")
+    with pytest.raises(ValueError, match="batch"):
+        fn(np.zeros((6, 512), np.float32))   # 6 % 8 != 0
+    with pytest.raises(ValueError, match="length"):
+        fn(np.zeros((8, 500), np.float32))   # 500 % 8 != 0
+    with pytest.raises(ValueError, match="batch, length"):
+        fn(np.zeros(512, np.float32))
+    with pytest.raises(ValueError, match="out must be"):
+        alltoall_map(lambda sig: sig, mesh, "seq", out="bogus")

@@ -15,8 +15,14 @@ parallelism inventory). This package maps those axes onto the TPU fabric:
   combinator: mesh-sharded signal, per-device overlapping FFT blocks
   processed as one batched kernel (SURVEY §5 long-context plan); plus the
   distributed overlap-save convolution built on it.
-* ``ops``      — sharded signal ops built on halo_map: convolution,
-  decimated and stationary wavelets; plus ``batch_map`` for data-parallel
+* ``alltoall`` — ``alltoall_map``, the Ulysses-style layout swap: one
+  ``all_to_all`` trades "a slice of every signal per device" for "all of
+  some signals per device", so whole-signal ops (global minmax, peak
+  compaction, mirror extensions) run unrestricted on sequence-sharded
+  batches; a mirror all_to_all restores the layout.
+* ``ops``      — sharded signal ops built on halo_map/alltoall_map:
+  convolution, decimated and stationary wavelets, per-signal
+  normalization and peak detection; plus ``batch_map`` for data-parallel
   batching of any single-signal op.
 """
 
@@ -25,10 +31,13 @@ from veles.simd_tpu.parallel.mesh import (  # noqa: F401
 from veles.simd_tpu.parallel.multihost import (  # noqa: F401
     hybrid_mesh, process_info)
 from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
+from veles.simd_tpu.parallel.alltoall import alltoall_map  # noqa: F401
 from veles.simd_tpu.parallel.pipeline import pipeline_map  # noqa: F401
 from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
     convolve_overlap_save_sharded, overlap_save_map)
 from veles.simd_tpu.parallel.ops import (  # noqa: F401
-    batch_map, convolve_sharded, stationary_wavelet_apply_sharded,
+    batch_map, convolve_sharded, detect_peaks_fixed_sharded,
+    minmax1D_sharded, normalize1D_sharded,
+    stationary_wavelet_apply_sharded,
     stationary_wavelet_decompose_sharded, wavelet_apply_sharded,
     wavelet_decompose_sharded)

@@ -1,0 +1,100 @@
+"""``alltoall_map`` — Ulysses-style sequence<->batch resharding combinator.
+
+``halo_map`` covers windowed ops: each device needs only O(window) boundary
+samples from its neighbors (the distributed overlap-save pattern,
+convolve.c:178-228). Ops that need the *whole* signal per output — global
+per-signal min/max (normalize.c:435-441), full-signal peak compaction
+(detect_peaks.c:58-127), mirror/constant extensions that read the far ends
+(wavelet.c:247-268) — cannot ride a halo. For a *batch* of sharded signals
+there is a second classic sequence-parallel layout swap (the DeepSpeed-
+Ulysses / all-to-all attention pattern): one ``all_to_all`` over ICI turns
+"every device holds a slice of every signal" into "every device holds all
+of some signals", the unrestricted local op runs on whole signals, and a
+mirror ``all_to_all`` restores sequence sharding. Communication is
+O(local bytes) per device either way — the trade is one transpose of the
+device grid instead of per-level halos.
+
+Rule of thumb: window-local op -> ``halo_map`` (no batch required);
+whole-signal op over a batch -> ``alltoall_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+OUT_LAYOUTS = ("seq", "batch")
+
+
+def alltoall_map(fn, mesh, axis="seq", *, out="seq", batch_axis=None,
+                 n_broadcast_args=0):
+    """Lift a whole-signal op onto batches of sequence-sharded signals.
+
+    ``fn(signals, *broadcast_args)`` receives a ``(local_batch/d, n)``
+    block of COMPLETE signals (d = mesh.shape[axis]) and runs unrestricted
+    — global reductions, data-dependent indexing, any extension mode.
+    Reserve this for ops that genuinely need whole signals; a per-signal
+    associative reduction (min/max/sum) is far cheaper as a
+    ``pmin``/``pmax``-style all-reduce (see parallel.minmax1D_sharded).
+    Returns a callable over the full ``(batch, n)`` array whose output is:
+
+    * ``out="seq"``   — re-resharded to the input layout: ``fn``'s output
+      (one array, last axis a multiple of d) comes back sharded along the
+      last axis, batch intact. Use when the result is itself a signal.
+    * ``out="batch"`` — left batch-sharded: any pytree of arrays with
+      leading dim ``local_batch/d``; globally the leading dim is sharded
+      over (batch_axis, axis). Use for per-signal results (peak lists) —
+      skips the return all_to_all entirely.
+
+    ``batch_axis`` mirrors halo_map's: ``None`` — the batch dim is
+    replicated across any other mesh axes; a mesh axis name — the batch
+    dim is additionally sharded over that axis (dp x sp on one mesh).
+    ``n_broadcast_args`` trailing arguments are replicated to every device.
+    """
+    if out not in OUT_LAYOUTS:
+        raise ValueError(f"out must be one of {OUT_LAYOUTS}")
+    d = mesh.shape[axis]
+    batch_shards = mesh.shape[batch_axis] if batch_axis else 1
+
+    def local(x_local, *args):
+        # (batch, n/d) slice-of-every-signal -> (batch/d, n) whole signals
+        full = jax.lax.all_to_all(x_local, axis, split_axis=0,
+                                  concat_axis=1, tiled=True)
+        y = fn(full, *args)
+        if out == "seq":
+            return jax.lax.all_to_all(y, axis, split_axis=y.ndim - 1,
+                                      concat_axis=0, tiled=True)
+        return y
+
+    in_spec = P(batch_axis, axis)
+    if out == "seq":
+        out_spec = P(batch_axis, axis)
+    else:
+        out_spec = P((batch_axis, axis)) if batch_axis else P(axis)
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(in_spec,) + (P(),) * n_broadcast_args,
+                        out_specs=out_spec)
+
+    @functools.wraps(fn)
+    def wrapped(x, *args):
+        x = jnp.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(
+                f"alltoall_map expects a (batch, length) array, got shape "
+                f"{x.shape}")
+        batch, n = x.shape
+        if batch % (batch_shards * d) != 0:
+            raise ValueError(
+                f"batch {batch} not divisible by {batch_shards * d} "
+                f"(= {batch_axis!r} shards x {d} {axis!r} devices; the "
+                "all_to_all swaps batch for sequence sharding)")
+        if n % d != 0:
+            raise ValueError(
+                f"signal length {n} not divisible by {d} shards")
+        return sharded(x, *args)
+
+    return wrapped

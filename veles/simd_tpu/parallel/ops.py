@@ -16,6 +16,7 @@ from jax.sharding import PartitionSpec as P
 from veles.simd_tpu import wavelet_data
 from veles.simd_tpu.ops.wavelet import (EXTENSION_PERIODIC, EXTENSION_ZERO,
                                         _dwt_bank, _swt_bank)
+from veles.simd_tpu.parallel.alltoall import alltoall_map
 from veles.simd_tpu.parallel.halo import halo_map
 
 _SHARDABLE_EXT = {EXTENSION_PERIODIC: "periodic", EXTENSION_ZERO: "zero"}
@@ -189,3 +190,74 @@ def stationary_wavelet_decompose_sharded(x, levels,
             lo, wavelet_type, order, level, ext, mesh=mesh, axis=axis)
         details.append(hi)
     return details, lo
+
+
+# ---------------------------------------------------------------------------
+# whole-signal ops over sequence-sharded batches (alltoall_map / Ulysses)
+# ---------------------------------------------------------------------------
+
+def minmax1D_sharded(x, *, mesh, axis="seq", batch_axis=None):
+    """Per-signal (min, max) of a sequence-sharded (batch, n) block ->
+    each (batch,), replicated along ``axis`` (minmax1D semantics,
+    normalize.c:318-367).
+
+    Min/max are associative, so the sharded form is a local row reduction
+    plus a ``pmin``/``pmax`` all-reduce over the sequence axis — O(batch)
+    scalars of ICI traffic, no layout swap, no batch-divisibility
+    constraint (contrast alltoall_map, which whole-signal ops need).
+    """
+    def local(x_loc):
+        vmin = jax.lax.pmin(jnp.min(x_loc, axis=-1), axis)
+        vmax = jax.lax.pmax(jnp.max(x_loc, axis=-1), axis)
+        return vmin, vmax
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(batch_axis, axis),),
+        out_specs=(P(batch_axis), P(batch_axis)))(
+            jnp.asarray(x, jnp.float32))
+
+
+def normalize1D_sharded(x, *, mesh, axis="seq", batch_axis=None):
+    """Per-signal [-1, 1] normalization of a (batch, n) block sharded
+    along the sequence axis; constant signals zero-fill (the
+    normalize.c:44-47 policy). Output layout matches the input.
+
+    The global per-signal min/max arrives by pmin/pmax all-reduce (see
+    minmax1D_sharded); the affine rescale is then purely local.
+    """
+    def local(x_loc):
+        vmin = jax.lax.pmin(jnp.min(x_loc, axis=-1, keepdims=True), axis)
+        vmax = jax.lax.pmax(jnp.max(x_loc, axis=-1, keepdims=True), axis)
+        diff = (vmax - vmin) * jnp.float32(0.5)
+        safe = jnp.where(diff > 0, diff, jnp.float32(1))
+        out = (x_loc - vmin) / safe - 1
+        return jnp.where(diff > 0, out, jnp.zeros_like(out))
+
+    spec = P(batch_axis, axis)
+    return shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec)(
+        jnp.asarray(x, jnp.float32))
+
+
+def detect_peaks_fixed_sharded(data, extremum_type=None, *, capacity, mesh,
+                               axis="seq", batch_axis=None):
+    """Fixed-capacity peak detection over a sequence-sharded (batch, n)
+    block -> (positions, values, count), each batch-sharded over
+    (batch_axis, axis).
+
+    Peak compaction ranks every selected sample against the whole signal
+    (detect_peaks.c:58-127) — positions here are GLOBAL sample indices,
+    which per-shard halo processing cannot produce without a second
+    compaction pass; the all_to_all layout swap gives each device complete
+    signals for a slice of the batch instead.
+    """
+    from veles.simd_tpu.ops.detect_peaks import (EXTREMUM_TYPE_BOTH,
+                                                 detect_peaks_fixed)
+
+    if extremum_type is None:
+        extremum_type = EXTREMUM_TYPE_BOTH
+
+    fn = alltoall_map(
+        lambda sig: detect_peaks_fixed(sig, extremum_type,
+                                       capacity=capacity, impl="xla"),
+        mesh, axis, out="batch", batch_axis=batch_axis)
+    return fn(jnp.asarray(data, jnp.float32))
