@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 # suites whose tests construct >= 8-device meshes inline
 _NEEDS_8_DEVICES = {"test_parallel.py", "test_overlap_save.py",
                     "test_multihost.py", "test_pipeline_pp.py",
-                    "test_alltoall.py"}
+                    "test_alltoall.py", "test_experts.py"}
 
 
 def pytest_collection_modifyitems(config, items):

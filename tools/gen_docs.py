@@ -12,6 +12,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import os
+import re
 import shutil
 import sys
 
@@ -40,6 +41,7 @@ MODULES = [
     "veles.simd_tpu.parallel.mesh",
     "veles.simd_tpu.parallel.halo",
     "veles.simd_tpu.parallel.alltoall",
+    "veles.simd_tpu.parallel.experts",
     "veles.simd_tpu.parallel.pipeline",
     "veles.simd_tpu.parallel.overlap_save",
     "veles.simd_tpu.parallel.ops",
@@ -104,11 +106,29 @@ def render_member(name, obj):
             if mdoc:
                 out.append(mdoc + "\n")
     else:
-        rep = repr(obj)
+        rep = _stable_repr(obj)
         if len(rep) > 120:
             rep = rep[:117] + "..."
         out.append(f"### `{name}` = `{rep}`\n")
     return "\n".join(out)
+
+
+def _stable_repr(obj):
+    """repr() without run-dependent noise, so regenerating the checked-in
+    docs never produces spurious diffs: functools.partial renders as the
+    wrapped function's name + bound kwargs (not its 0x address), sets
+    render sorted, and any remaining memory addresses are stripped."""
+    import functools as _ft
+    if isinstance(obj, _ft.partial):
+        parts = [getattr(obj.func, "__qualname__", repr(obj.func))]
+        parts += [repr(a) for a in obj.args]
+        parts += [f"{k}={v!r}" for k, v in sorted(obj.keywords.items())]
+        return f"partial({', '.join(parts)})"
+    if isinstance(obj, (set, frozenset)):
+        body = ", ".join(sorted(map(repr, obj)))
+        return ("frozenset({%s})" if isinstance(obj, frozenset)
+                else "{%s}") % body
+    return re.sub(r" at 0x[0-9a-f]+", "", repr(obj))
 
 
 def main():

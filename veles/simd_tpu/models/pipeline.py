@@ -10,7 +10,6 @@ sequence halos over ICI, the head contraction psum-reduced by XLA.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from veles.simd_tpu import ops
@@ -37,13 +36,7 @@ class SignalPipeline:
         x = ops.normalize1D(signal, impl="xla")
 
         # FIR filtering, same-length output (truncated linear convolution)
-        m = fir.shape[-1]
-        lhs = x[:, None, :]
-        rhs = fir[::-1][None, None, :]
-        y = jax.lax.conv_general_dilated(
-            lhs, rhs, (1,), [(m - 1, 0)],
-            dimension_numbers=("NCH", "OIH", "NCH"))
-        y = y[:, 0, :]
+        y = ops.causal_fir(x, fir)
 
         # stationary wavelet feature bands — full-length hi/lo
         bhi, blo = ops.stationary_wavelet_apply(

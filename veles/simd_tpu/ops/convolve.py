@@ -144,6 +144,38 @@ def _convolve_direct_xla(x, h, reverse=False):
     return acc
 
 
+@jax.jit
+def causal_fir(x, h):
+    """Same-length causal FIR: y[t] = sum_j h[j]*x[t-j], zero left-padding
+    (the first n samples of the linear convolution). Batch-aware over
+    leading axes of ``x``.
+
+    Framework extension (the reference only has full-length convolve):
+    this is THE small-kernel filtering primitive the composed models and
+    parallel combinators share, in the shift-add formulation that wins on
+    TPU (see _convolve_direct_xla; an N=C=1 conv_general_dilated lowering
+    is pathological, and batched convs still lose to the fused VPU pass
+    for small m).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    n, m = x.shape[-1], h.shape[-1]
+    if m > _DIRECT_UNROLL_MAX_H:
+        lead = x.shape[:-1]
+        lhs = x.reshape(-1, 1, n)
+        rhs = h[::-1].reshape(1, 1, m)
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,), padding=[(m - 1, 0)],
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        return out.reshape(*lead, n)
+    pad = [(0, 0)] * (x.ndim - 1) + [(m - 1, 0)]
+    padded = jnp.pad(x, pad)
+    acc = jnp.zeros_like(x)
+    for j in range(m):
+        acc = acc + padded[..., m - 1 - j:m - 1 - j + n] * h[j]
+    return acc
+
+
 # ---------------------------------------------------------------------------
 # full FFT
 # ---------------------------------------------------------------------------

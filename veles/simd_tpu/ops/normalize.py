@@ -32,17 +32,23 @@ def _minmax1D_xla(src):
     return jnp.min(src, axis=-1), jnp.max(src, axis=-1)
 
 
+def rescale_minmax(src, vmin, vmax):
+    """The [-1, 1] affine rescale given per-signal broadcastable min/max;
+    min == max -> zero fill (normalize.c:44-47; jnp.where keeps it
+    jittable). The single home of the policy — the 1-D/2-D ops and the
+    sharded twin (parallel.normalize1D_sharded) all call this."""
+    diff = (vmax - vmin) * jnp.float32(0.5)
+    safe = jnp.where(diff > 0, diff, jnp.float32(1))
+    out = (src - vmin) / safe - 1
+    return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
+
+
 @jax.jit
 def _normalize2D_minmax_xla(vmin, vmax, src):
     src = jnp.asarray(src, jnp.float32)
-    vmin = jnp.asarray(vmin, jnp.float32)
-    vmax = jnp.asarray(vmax, jnp.float32)
-    diff = (vmax - vmin) * jnp.float32(0.5)
-    # min == max -> zero fill (normalize.c:44-47); jnp.where keeps it jittable
-    safe = jnp.where(diff > 0, diff, jnp.float32(1))
-    out = (src - vmin[..., None, None]) / safe[..., None, None] - 1
-    return jnp.where((diff > 0)[..., None, None], out,
-                     jnp.zeros_like(out)).astype(jnp.float32)
+    vmin = jnp.asarray(vmin, jnp.float32)[..., None, None]
+    vmax = jnp.asarray(vmax, jnp.float32)[..., None, None]
+    return rescale_minmax(src, vmin, vmax)
 
 
 @jax.jit
@@ -56,10 +62,7 @@ def _normalize1D_xla(src):
     src = jnp.asarray(src, jnp.float32)
     vmin = jnp.min(src, axis=-1, keepdims=True)
     vmax = jnp.max(src, axis=-1, keepdims=True)
-    diff = (vmax - vmin) * jnp.float32(0.5)
-    safe = jnp.where(diff > 0, diff, jnp.float32(1))
-    out = (src - vmin) / safe - 1
-    return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
+    return rescale_minmax(src, vmin, vmax)
 
 
 def normalize1D(src, *, impl=None):

@@ -20,6 +20,9 @@ parallelism inventory). This package maps those axes onto the TPU fabric:
   some signals per device", so whole-signal ops (global minmax, peak
   compaction, mirror extensions) run unrestricted on sequence-sharded
   batches; a mirror all_to_all restores the layout.
+* ``experts``  — ``expert_map``/``routed_fir_bank``, expert parallelism:
+  top-1-routed expert shards (mixture of filters) with one-hot MXU
+  dispatch/combine and all_to_all transport over the expert axis.
 * ``ops``      — sharded signal ops built on halo_map/alltoall_map:
   convolution, decimated and stationary wavelets, per-signal
   normalization and peak detection; plus ``batch_map`` for data-parallel
@@ -33,6 +36,8 @@ from veles.simd_tpu.parallel.multihost import (  # noqa: F401
 from veles.simd_tpu.parallel.halo import halo_map  # noqa: F401
 from veles.simd_tpu.parallel.alltoall import alltoall_map  # noqa: F401
 from veles.simd_tpu.parallel.pipeline import pipeline_map  # noqa: F401
+from veles.simd_tpu.parallel.experts import (  # noqa: F401
+    expert_map, routed_fir_bank)
 from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
     convolve_overlap_save_sharded, overlap_save_map)
 from veles.simd_tpu.parallel.ops import (  # noqa: F401

@@ -225,13 +225,12 @@ def normalize1D_sharded(x, *, mesh, axis="seq", batch_axis=None):
     The global per-signal min/max arrives by pmin/pmax all-reduce (see
     minmax1D_sharded); the affine rescale is then purely local.
     """
+    from veles.simd_tpu.ops.normalize import rescale_minmax
+
     def local(x_loc):
         vmin = jax.lax.pmin(jnp.min(x_loc, axis=-1, keepdims=True), axis)
         vmax = jax.lax.pmax(jnp.max(x_loc, axis=-1, keepdims=True), axis)
-        diff = (vmax - vmin) * jnp.float32(0.5)
-        safe = jnp.where(diff > 0, diff, jnp.float32(1))
-        out = (x_loc - vmin) / safe - 1
-        return jnp.where(diff > 0, out, jnp.zeros_like(out))
+        return rescale_minmax(x_loc, vmin, vmax)
 
     spec = P(batch_axis, axis)
     return shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec)(
