@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Expert-parallel routed filter bank demo on a virtual 8-device mesh.
+
+    python examples/routed_filters.py
+
+Eight FIR "experts" (bandpass filters at different center frequencies)
+live sharded one-per-device; each incoming signal is routed to the expert
+whose band matches its dominant frequency (here the gate is computed from
+a cheap 8-bin energy measurement — in a learned system it would be a
+trained gating head). Dispatch/combine are one-hot einsums on the MXU and
+one all_to_all each way over the expert axis. The exact same code runs on
+a real v5e-8 slice.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import parallel
+
+    mesh = parallel.make_mesh({"expert": 8})
+    e, batch, n, m = 8, 16, 1024, 63
+    rng = np.random.default_rng(0)
+
+    # expert k = windowed-sinc bandpass around f_k (lowpass prototype of
+    # half-width w modulated up to the band center -> unit gain at f_k)
+    centers = (np.arange(e) + 0.5) / (2.0 * e)        # cycles/sample
+    t = np.arange(m) - (m - 1) / 2
+    w = 1.0 / (4.0 * e)
+    proto = 2 * w * np.sinc(2 * w * t) * np.hamming(m)
+    taps = np.stack([
+        2 * proto * np.cos(2 * np.pi * c * t) for c in centers
+    ]).astype(np.float32)
+
+    # each signal: a pure tone in one band + broadband noise
+    tone_band = rng.integers(0, e, size=batch)
+    phase = rng.uniform(0, 2 * np.pi, size=(batch, 1))
+    x = (np.sin(2 * np.pi * centers[tone_band][:, None]
+                * np.arange(n)[None, :] + phase)
+         + 0.3 * rng.normal(size=(batch, n))).astype(np.float32)
+
+    # gate: energy per band from an 8-point DFT magnitude of strided sums
+    spec = np.abs(np.fft.rfft(x, axis=-1))
+    edges = np.linspace(0, spec.shape[-1], e + 1).astype(int)
+    logits = np.stack([
+        spec[:, a:b].sum(axis=-1) for a, b in zip(edges[:-1], edges[1:])
+    ], axis=-1).astype(np.float32)
+
+    y = parallel.routed_fir_bank(x, logits, taps, mesh=mesh)
+
+    routed_to = logits.argmax(axis=-1)
+    accuracy = float(np.mean(routed_to == tone_band))
+    # the matched bandpass keeps the tone: output RMS stays near the
+    # tone's RMS (~0.71) instead of the noisy input's
+    rms_out = float(jnp.sqrt(jnp.mean(y ** 2)))
+    print(f"devices: {jax.device_count()}, mesh: {dict(mesh.shape)}")
+    print(f"routing accuracy (energy gate vs true band): {accuracy:.0%}")
+    print(f"output RMS {rms_out:.3f} (tone RMS ~0.707, input RMS "
+          f"{float(np.sqrt(np.mean(x**2))):.3f})")
+    assert accuracy == 1.0
+    assert abs(rms_out - 0.707) < 0.08
+
+
+if __name__ == "__main__":
+    main()

@@ -13,11 +13,14 @@
 // C ABI only — consumed from Python via ctypes (no pybind11 in the image).
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <new>
+#include <thread>
 #include <vector>
 
 #if defined(_WIN32)
@@ -286,4 +289,154 @@ VH_API int vh_pool_destroy(int64_t handle) {
   return 0;
 }
 
-VH_API int vh_abi_version() { return 1; }
+// ---------------------------------------------------------------------------
+// Prefetching binary stream reader — the IO stage of the feed path.
+//
+// The reference has no IO layer (callers pass in-memory arrays); a device
+// framework's data loader does, and disk latency must overlap staging and
+// transfer.  A dedicated reader thread keeps one chunk in flight: it fills
+// one aligned buffer while the consumer holds the other (classic double
+// buffer, capacity-1 handoff).  The consumer's view stays valid until its
+// next vh_stream_next call — exactly the lease the staging copy needs.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Stream {
+  FILE* f = nullptr;
+  size_t chunk = 0;
+  char* buf[2] = {nullptr, nullptr};
+  size_t len[2] = {0, 0};
+  int ready = -1;      // filled, waiting for the consumer (-1: none)
+  int held = -1;       // handed to the consumer, must not be refilled
+  bool done = false;   // reader thread exited (EOF or error)
+  bool error = false;
+  bool stop = false;
+  int64_t file_size = -1;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_ready;    // consumer waits for a chunk
+  std::condition_variable cv_free;     // reader waits for a free buffer
+};
+
+std::mutex g_streams_mu;
+std::vector<Stream*> g_streams;
+
+Stream* stream_from_handle(int64_t handle) {
+  std::lock_guard<std::mutex> lock(g_streams_mu);
+  if (handle < 0 || handle >= static_cast<int64_t>(g_streams.size()))
+    return nullptr;
+  return g_streams[static_cast<size_t>(handle)];
+}
+
+void stream_reader_main(Stream* s) {
+  int fill = 0;
+  for (;;) {
+    {
+      // wait until `fill` is neither ready nor in the consumer's hands
+      std::unique_lock<std::mutex> lock(s->mu);
+      s->cv_free.wait(lock, [&] {
+        return s->stop || (s->ready == -1 && s->held != fill);
+      });
+      if (s->stop) break;
+    }
+    size_t n = fread(s->buf[fill], 1, s->chunk, s->f);
+    bool at_end = n < s->chunk;
+    bool failed = at_end && ferror(s->f);
+    {
+      std::lock_guard<std::mutex> lock(s->mu);
+      if (n > 0 && !failed) {
+        s->len[fill] = n;
+        s->ready = fill;
+      }
+      if (failed) s->error = true;
+      if (at_end) s->done = true;
+      s->cv_ready.notify_one();
+    }
+    if (at_end) break;
+    fill ^= 1;
+  }
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->done = true;
+  s->cv_ready.notify_one();
+}
+
+}  // namespace
+
+// Opens `path` and starts the prefetch thread.  Returns a handle, or -1.
+VH_API int64_t vh_stream_open(const char* path, size_t chunk_bytes) {
+  if (!path || chunk_bytes == 0) return -1;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  auto* s = new (std::nothrow) Stream;
+  if (!s) {
+    fclose(f);
+    return -1;
+  }
+  s->f = f;
+  s->chunk = chunk_bytes;
+  if (fseeko(f, 0, SEEK_END) == 0) {
+    s->file_size = static_cast<int64_t>(ftello(f));
+    fseeko(f, 0, SEEK_SET);
+  }
+  for (int i = 0; i < 2; ++i) {
+    s->buf[i] = static_cast<char*>(vh_alloc_aligned(chunk_bytes, 0));
+    if (!s->buf[i]) {
+      free(s->buf[0]);
+      fclose(f);
+      delete s;
+      return -1;
+    }
+  }
+  s->worker = std::thread(stream_reader_main, s);
+  std::lock_guard<std::mutex> lock(g_streams_mu);
+  g_streams.push_back(s);
+  return static_cast<int64_t>(g_streams.size()) - 1;
+}
+
+// Blocks for the next prefetched chunk.  1 = chunk delivered (*data valid
+// until the NEXT vh_stream_next/close), 0 = clean EOF, -1 = error.
+VH_API int vh_stream_next(int64_t handle, void** data, int64_t* nbytes) {
+  Stream* s = stream_from_handle(handle);
+  if (!s || !data || !nbytes) return -1;
+  std::unique_lock<std::mutex> lock(s->mu);
+  s->cv_ready.wait(lock, [&] { return s->ready != -1 || s->done; });
+  if (s->ready == -1) {
+    *data = nullptr;
+    *nbytes = 0;
+    return s->error ? -1 : 0;
+  }
+  s->held = s->ready;   // previous held buffer becomes refillable
+  s->ready = -1;
+  *data = s->buf[s->held];
+  *nbytes = static_cast<int64_t>(s->len[s->held]);
+  s->cv_free.notify_one();
+  return 1;
+}
+
+VH_API int64_t vh_stream_file_size(int64_t handle) {
+  Stream* s = stream_from_handle(handle);
+  return s ? s->file_size : -1;
+}
+
+// Idempotent; joins the reader thread.  The Stream struct is never
+// deleted (same stale-handle policy as pools); buffers are freed.
+VH_API int vh_stream_close(int64_t handle) {
+  Stream* s = stream_from_handle(handle);
+  if (!s) return -1;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (!s->f) return 0;  // already closed
+    s->stop = true;
+    s->cv_free.notify_one();
+  }
+  if (s->worker.joinable()) s->worker.join();
+  fclose(s->f);
+  s->f = nullptr;
+  free(s->buf[0]);
+  free(s->buf[1]);
+  s->buf[0] = s->buf[1] = nullptr;
+  return 0;
+}
+
+VH_API int vh_abi_version() { return 2; }

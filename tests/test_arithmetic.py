@@ -71,6 +71,7 @@ def test_complex_ops(impl, n, rng):
                                rtol=1e-6)
 
 
+@pytest.mark.native_complex
 def test_complex_native_passthrough(rng):
     a = (rng.normal(size=8) + 1j * rng.normal(size=8)).astype(np.complex64)
     b = (rng.normal(size=8) + 1j * rng.normal(size=8)).astype(np.complex64)

@@ -5,6 +5,7 @@ construction contracts on CPU with tiny shapes.
 """
 
 import math
+import os
 
 import pytest
 
@@ -35,6 +36,10 @@ def test_non_finite_checksum_raises():
                    jnp.full(4, 1e30, jnp.float32), iters=64, reps=1)
 
 
+@pytest.mark.skipif(os.environ.get("VELES_TEST_TPU") == "1",
+                    reason="RTT-floor detection is inherently noisy on the "
+                           "live tunnel; the mechanics are platform-free "
+                           "and validated on CPU")
 def test_on_floor_nan_keeps_other_configs():
     carry = jnp.ones(8, jnp.float32)
     steps = {

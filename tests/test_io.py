@@ -1,0 +1,104 @@
+"""File IO stage (host.io): prefetched stream reading, fallback path,
+and the read -> stage -> transfer pipeline composition."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.host import io as hio
+from veles.simd_tpu.host.feed import FeedPipeline
+
+
+@pytest.fixture
+def i16_file(tmp_path, rng):
+    data = rng.integers(-30000, 30000, size=48_000).astype(np.int16)
+    path = tmp_path / "signal.i16"
+    path.write_bytes(data.tobytes())
+    return path, data
+
+
+def test_filestream_roundtrip_with_ragged_tail(i16_file, rng):
+    path, data = i16_file
+    # 48000 int16 = 96000 bytes; 25000-byte chunks -> 3 full + ragged tail
+    chunks = []
+    with hio.FileStream(path, np.int16, chunk_bytes=25_000) as fs:
+        assert fs.file_size == data.nbytes
+        for chunk in fs:
+            chunks.append(chunk.copy())   # views die at next iteration
+    sizes = [len(c) for c in chunks]
+    assert sizes == [12_500, 12_500, 12_500, 10_500]
+    np.testing.assert_array_equal(np.concatenate(chunks), data)
+
+
+def test_read_signal_exact_multiple(tmp_path, rng):
+    data = rng.normal(size=4096).astype(np.float32)
+    path = tmp_path / "sig.f32"
+    path.write_bytes(data.tobytes())
+    got = hio.read_signal(path, np.float32, chunk_bytes=4096)
+    np.testing.assert_array_equal(got, data)
+
+
+def test_view_lease_is_per_iteration(i16_file):
+    path, data = i16_file
+    with hio.FileStream(path, np.int16, chunk_bytes=24_000) as fs:
+        first = next(fs)
+        first_copy = first.copy()
+        next(fs)  # invalidates `first`'s buffer lease
+        np.testing.assert_array_equal(first_copy, data[:12_000])
+
+
+def test_file_batches_drops_ragged_tail(i16_file):
+    path, data = i16_file
+    # copy per iteration: yields are views with a one-iteration lease
+    batches = [b.copy() for b in hio.file_batches(path, (5, 2000),
+                                                  np.int16)]
+    assert len(batches) == 4          # 48000 // 10000, tail 8000 dropped
+    for i, b in enumerate(batches):
+        assert b.shape == (5, 2000)
+        np.testing.assert_array_equal(
+            b.ravel(), data[i * 10_000:(i + 1) * 10_000])
+
+
+def test_feed_pipeline_from_file(i16_file):
+    # the full loader: C++ prefetch thread -> staged conversion -> device
+    path, data = i16_file
+    src = hio.file_batches(path, (5, 2000), np.int16)
+    got = []
+    with FeedPipeline(src, dtype=np.float32, depth=2) as feed:
+        for dev in feed:
+            got.append(np.asarray(dev))
+    assert len(got) == 4
+    want = data[:40_000].astype(np.float32).reshape(4, 5, 2000)
+    for i, g in enumerate(got):
+        np.testing.assert_array_equal(g, want[i])
+
+
+def test_errors(tmp_path):
+    with pytest.raises(OSError):
+        hio.FileStream(tmp_path / "missing.bin", np.int16)
+    odd = tmp_path / "odd.bin"
+    odd.write_bytes(b"\x00" * 7)      # not a multiple of int16
+    with pytest.raises(ValueError, match="multiple"):
+        hio.FileStream(odd, np.int16)
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        hio.FileStream(tmp_path / "x", np.int16, chunk_bytes=3)
+
+
+def test_fallback_without_native(i16_file):
+    path, data = i16_file
+    code = (
+        "import numpy as np; from veles.simd_tpu.host import io as hio; "
+        f"got = hio.read_signal({str(path)!r}, np.int16, "
+        "chunk_bytes=25000); "
+        "assert not hio._native.available(); "
+        f"assert got.nbytes == {data.nbytes}; "
+        "print(int(got[:100].sum()))")
+    env = dict(os.environ, VELES_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, cwd=repo)
+    assert r.returncode == 0, r.stderr
+    assert int(r.stdout.strip().splitlines()[-1]) == int(data[:100].sum())

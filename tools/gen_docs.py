@@ -36,6 +36,7 @@ MODULES = [
     "veles.simd_tpu.contracts",
     "veles.simd_tpu.host",
     "veles.simd_tpu.host.feed",
+    "veles.simd_tpu.host.io",
     "veles.simd_tpu.wavelet_data",
     "veles.simd_tpu.compat",
     "veles.simd_tpu.parallel.mesh",
@@ -119,16 +120,20 @@ def _stable_repr(obj):
     wrapped function's name + bound kwargs (not its 0x address), sets
     render sorted, and any remaining memory addresses are stripped."""
     import functools as _ft
+
+    def strip(s):
+        return re.sub(r" at 0x[0-9a-f]+", "", s)
+
     if isinstance(obj, _ft.partial):
         parts = [getattr(obj.func, "__qualname__", repr(obj.func))]
         parts += [repr(a) for a in obj.args]
         parts += [f"{k}={v!r}" for k, v in sorted(obj.keywords.items())]
-        return f"partial({', '.join(parts)})"
+        return strip(f"partial({', '.join(parts)})")
     if isinstance(obj, (set, frozenset)):
-        body = ", ".join(sorted(map(repr, obj)))
+        body = ", ".join(sorted(strip(repr(m)) for m in obj))
         return ("frozenset({%s})" if isinstance(obj, frozenset)
                 else "{%s}") % body
-    return re.sub(r" at 0x[0-9a-f]+", "", repr(obj))
+    return strip(repr(obj))
 
 
 def main():

@@ -21,11 +21,11 @@ _LOCK = threading.Lock()
 _LIB = None
 _TRIED = False
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 
 
 def _build(src: str, out: str) -> bool:
-    base = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
+    base = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC", "-pthread",
             "-fvisibility=hidden", "-o", out, src]
     for extra in (["-march=native"], []):
         try:
@@ -72,6 +72,15 @@ def _signatures(lib: ctypes.CDLL) -> None:
         fn.argtypes = [c.c_int64]
     lib.vh_pool_destroy.restype = c.c_int
     lib.vh_pool_destroy.argtypes = [c.c_int64]
+    lib.vh_stream_open.restype = c.c_int64
+    lib.vh_stream_open.argtypes = [c.c_char_p, c.c_size_t]
+    lib.vh_stream_next.restype = c.c_int
+    lib.vh_stream_next.argtypes = [c.c_int64, c.POINTER(c.c_void_p),
+                                   c.POINTER(c.c_int64)]
+    lib.vh_stream_file_size.restype = c.c_int64
+    lib.vh_stream_file_size.argtypes = [c.c_int64]
+    lib.vh_stream_close.restype = c.c_int
+    lib.vh_stream_close.argtypes = [c.c_int64]
     lib.vh_abi_version.restype = c.c_int
     lib.vh_abi_version.argtypes = []
 
@@ -103,10 +112,15 @@ def _load_locked():
         os.replace(tmp, so)  # atomic vs concurrent builders
     try:
         lib = ctypes.CDLL(so)
-        _signatures(lib)
+        # ABI gate BEFORE binding signatures: a stale .so with a newer
+        # mtime (rsync/docker mtime scrambles defeat the rebuild check)
+        # lacks newer symbols, and the attribute lookups would raise.
+        lib.vh_abi_version.restype = ctypes.c_int
+        lib.vh_abi_version.argtypes = []
         if lib.vh_abi_version() != ABI_VERSION:
             return None
-    except OSError:
+        _signatures(lib)
+    except (OSError, AttributeError):
         return None
     return lib
 

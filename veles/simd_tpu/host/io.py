@@ -1,0 +1,154 @@
+"""File IO stage of the feed path: prefetched binary stream reading.
+
+The reference has no IO layer — callers hand it in-memory arrays
+(SURVEY §2; every op takes pointers). A device framework's data loader
+starts at disk, and disk latency must overlap staging and transfer.
+``FileStream`` wraps the native double-buffered reader
+(native/veles_host.cpp ``vh_stream_*``): a C++ thread fills one aligned
+buffer while Python consumes the other, so ``FeedPipeline(file_batches(
+path, shape))`` keeps three stages in flight at once — read (C++ thread),
+stage+convert (feed worker), device transfer (XLA async).
+
+Chunks are yielded as zero-copy NumPy views valid until the next
+iteration step — exactly the lease the staging copy needs. Falls back to
+plain buffered ``file.readinto`` when the native library is unavailable
+(``VELES_NO_NATIVE=1``): same semantics, no prefetch thread.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from veles.simd_tpu.host import _native
+
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+class FileStream:
+    """Iterate a binary file as dtype-typed chunks (zero-copy views).
+
+    Each yielded array is a view over an internal double buffer and is
+    valid only until the next ``__next__``/``close`` — copy (or stage,
+    which copies) before then. The file length must be a multiple of the
+    dtype itemsize; a ragged final chunk shorter than ``chunk_bytes`` is
+    yielded at its true length.
+    """
+
+    def __init__(self, path, dtype=np.float32, *,
+                 chunk_bytes=DEFAULT_CHUNK_BYTES):
+        self.dtype = np.dtype(dtype)
+        if chunk_bytes % self.dtype.itemsize != 0:
+            raise ValueError(
+                f"chunk_bytes {chunk_bytes} not a multiple of itemsize "
+                f"{self.dtype.itemsize}")
+        self._path = os.fspath(path)
+        self._chunk_bytes = chunk_bytes
+        self._lib = _native.load()
+        self._handle = None
+        self._file = None
+        if self._lib is not None:
+            handle = self._lib.vh_stream_open(
+                self._path.encode(), chunk_bytes)
+            if handle < 0:
+                raise OSError(f"cannot open {self._path!r}")
+            self._handle = handle
+            self.file_size = int(self._lib.vh_stream_file_size(handle))
+        else:
+            self._file = open(self._path, "rb", buffering=0)
+            self.file_size = os.fstat(self._file.fileno()).st_size
+            self._fallback_buf = bytearray(chunk_bytes)
+        if self.file_size % self.dtype.itemsize != 0:
+            self.close()
+            raise ValueError(
+                f"file size {self.file_size} not a multiple of "
+                f"{self.dtype} itemsize")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is not None:
+            data = ctypes.c_void_p()
+            nbytes = ctypes.c_int64()
+            rc = self._lib.vh_stream_next(
+                self._handle, ctypes.byref(data), ctypes.byref(nbytes))
+            if rc < 0:
+                raise OSError(f"read error on {self._path!r}")
+            if rc == 0:
+                raise StopIteration
+            n = nbytes.value // self.dtype.itemsize
+            buf = (ctypes.c_char * nbytes.value).from_address(data.value)
+            return np.frombuffer(buf, dtype=self.dtype, count=n)
+        if self._file is None:
+            raise StopIteration
+        # unbuffered read(2) may legally return short mid-file (NFS,
+        # FUSE): keep reading until the chunk is full or EOF
+        view = memoryview(self._fallback_buf)
+        filled = 0
+        while filled < len(view):
+            got = self._file.readinto(view[filled:])
+            if not got:
+                break
+            filled += got
+        if filled == 0:
+            raise StopIteration
+        n = filled // self.dtype.itemsize
+        return np.frombuffer(
+            self._fallback_buf, dtype=self.dtype, count=n)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.vh_stream_close(self._handle)
+            self._handle = None
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __del__(self):
+        # abandoning a stream must not leak the C++ reader thread and its
+        # two chunk buffers (same convention as StagingPool.__del__)
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_signal(path, dtype=np.float32, *,
+                chunk_bytes=DEFAULT_CHUNK_BYTES):
+    """Whole file -> one contiguous array (through the prefetched
+    stream)."""
+    with FileStream(path, dtype, chunk_bytes=chunk_bytes) as fs:
+        out = np.empty(fs.file_size // fs.dtype.itemsize, fs.dtype)
+        pos = 0
+        for chunk in fs:
+            out[pos:pos + len(chunk)] = chunk
+            pos += len(chunk)
+    return out
+
+
+def file_batches(path, batch_shape, dtype=np.int16):
+    """Generator of ``batch_shape`` arrays from a raw binary file — the
+    source side of ``FeedPipeline`` (read -> stage -> transfer pipeline).
+
+    The chunk size is the batch size, so each yield is one prefetched
+    double-buffer handoff; a final partial batch is dropped (device
+    shapes are static). The yielded views are only valid until the next
+    yield — FeedPipeline's staging copy honors that lease.
+    """
+    batch_shape = tuple(int(d) for d in batch_shape)
+    dtype = np.dtype(dtype)
+    per_batch = int(np.prod(batch_shape)) * dtype.itemsize
+    with FileStream(path, dtype, chunk_bytes=per_batch) as fs:
+        for chunk in fs:
+            if chunk.size * dtype.itemsize < per_batch:
+                break  # ragged tail: static device shapes drop it
+            yield chunk.reshape(batch_shape)

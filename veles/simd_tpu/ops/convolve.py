@@ -145,18 +145,7 @@ def _convolve_direct_xla(x, h, reverse=False):
 
 
 @jax.jit
-def causal_fir(x, h):
-    """Same-length causal FIR: y[t] = sum_j h[j]*x[t-j], zero left-padding
-    (the first n samples of the linear convolution). Batch-aware over
-    leading axes of ``x``.
-
-    Framework extension (the reference only has full-length convolve):
-    this is THE small-kernel filtering primitive the composed models and
-    parallel combinators share, in the shift-add formulation that wins on
-    TPU (see _convolve_direct_xla; an N=C=1 conv_general_dilated lowering
-    is pathological, and batched convs still lose to the fused VPU pass
-    for small m).
-    """
+def _causal_fir_xla(x, h):
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     n, m = x.shape[-1], h.shape[-1]
@@ -174,6 +163,21 @@ def causal_fir(x, h):
     for j in range(m):
         acc = acc + padded[..., m - 1 - j:m - 1 - j + n] * h[j]
     return acc
+
+
+def causal_fir(x, h):
+    """Same-length causal FIR: y[t] = sum_j h[j]*x[t-j], zero left-padding
+    (the first n samples of the linear convolution). Batch-aware over
+    leading axes of ``x``.
+
+    Framework extension (the reference only has full-length convolve):
+    this is THE small-kernel filtering primitive the composed models and
+    parallel combinators share, in the shift-add formulation that wins on
+    TPU (see _convolve_direct_xla; an N=C=1 conv_general_dilated lowering
+    is pathological, and batched convs still lose to the fused VPU pass
+    for small m).
+    """
+    return _causal_fir_xla(x, h)
 
 
 # ---------------------------------------------------------------------------

@@ -86,12 +86,13 @@ def expert_map(fn, mesh, axis="expert", *, n_experts, capacity,
         return jnp.einsum("bec,ecn->bn", disp, y,
                           precision=jax.lax.Precision.HIGHEST)
 
-    sharded = shard_map(
+    # jitted so a layer built once compiles once per shape (the handle
+    # convention: build at init, call in the hot loop)
+    sharded = jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=P(axis))
+        out_specs=P(axis)))
 
-    @functools.wraps(fn)
     def routed(x, gate_logits, params):
         x = jnp.asarray(x)
         gate_logits = jnp.asarray(gate_logits)
@@ -111,6 +112,7 @@ def expert_map(fn, mesh, axis="expert", *, n_experts, capacity,
                     f"{n_experts}; got shape {jnp.shape(leaf)}")
         return sharded(x, gate_logits, params)
 
+    routed.__name__ = f"routed_{getattr(fn, '__name__', 'expert')}"
     return routed
 
 
@@ -124,14 +126,22 @@ def routed_fir_bank(x, gate_logits, taps, *, mesh, axis="expert",
     signals batch-sharded. The ep showcase op: one all_to_all each way,
     filters on the VPU, dispatch/combine on the MXU.
     """
-    from veles.simd_tpu.ops.convolve import causal_fir
-
     x = jnp.asarray(x, jnp.float32)
     taps = jnp.asarray(taps, jnp.float32)
     e = taps.shape[0]
     if capacity is None:
         capacity = x.shape[0] // mesh.shape[axis]   # skew-proof default
-
-    fn = expert_map(lambda h, tokens: causal_fir(tokens, h), mesh, axis,
-                    n_experts=e, capacity=capacity, weighted=weighted)
+    fn = _fir_expert_layer(mesh, axis, e, capacity, weighted)
     return fn(x, gate_logits, taps)
+
+
+@functools.lru_cache(maxsize=64)
+def _fir_expert_layer(mesh, axis, n_experts, capacity, weighted):
+    """One built (traced+compiled) layer per routing configuration —
+    repeated routed_fir_bank calls hit the jit cache instead of
+    re-tracing a fresh shard_map every invocation."""
+    from veles.simd_tpu.ops.convolve import causal_fir
+
+    return expert_map(lambda h, tokens: causal_fir(tokens, h), mesh, axis,
+                      n_experts=n_experts, capacity=capacity,
+                      weighted=weighted)
