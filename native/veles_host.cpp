@@ -400,6 +400,11 @@ VH_API int vh_stream_next(int64_t handle, void** data, int64_t* nbytes) {
   Stream* s = stream_from_handle(handle);
   if (!s || !data || !nbytes) return -1;
   std::unique_lock<std::mutex> lock(s->mu);
+  if (!s->f) {  // closed: buffers are freed, never hand out a pointer
+    *data = nullptr;
+    *nbytes = 0;
+    return -1;
+  }
   s->cv_ready.wait(lock, [&] { return s->ready != -1 || s->done; });
   if (s->ready == -1) {
     *data = nullptr;
@@ -428,6 +433,7 @@ VH_API int vh_stream_close(int64_t handle) {
     std::lock_guard<std::mutex> lock(s->mu);
     if (!s->f) return 0;  // already closed
     s->stop = true;
+    s->ready = -1;  // pending chunk is void once buffers are freed below
     s->cv_free.notify_one();
   }
   if (s->worker.joinable()) s->worker.join();

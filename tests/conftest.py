@@ -47,7 +47,7 @@ def _backend_supports_native_complex():
         import jax._src.xla_bridge as xb
         version = getattr(xb.get_backend(), "platform_version", "")
     except Exception:
-        return True
+        return False  # inconclusive probe: skipping is safe, poisoning isn't
     return "axon" not in version
 
 

@@ -68,3 +68,13 @@ def test_on_floor_raise_default(monkeypatch):
     with pytest.raises(RuntimeError, match="floor"):
         chain_times({"free": lambda c: c}, jnp.ones(8, jnp.float32),
                     iters=32, reps=1)
+
+
+def test_feed_io_config_smoke():
+    # the loader-throughput config must produce a finite positive rate
+    # at tiny scale (bench_extra configs are otherwise only run on TPU)
+    from veles.simd_tpu.utils.bench_extra import bench_feed_io
+
+    out = bench_feed_io(scale=1 / 64)
+    assert out["unit"] == "MSamples/s"
+    assert math.isfinite(out["value"]) and out["value"] > 0

@@ -6,6 +6,8 @@ size grid the reference benchmarks (x in {32..2000}, h in {50..950}) with
 every algorithm forced, plus the auto-selector contract.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -33,6 +35,13 @@ SIZES = [(32, 5), (50, 12), (200, 50), (350, 127), (1020, 50), (2000, 512),
 def test_convolve_differential(x_len, h_len, algorithm, rng):
     if algorithm == "overlap_save" and h_len >= x_len / 2:
         pytest.skip("overlap_save precondition")
+    if (algorithm == "direct" and h_len > 512
+            and os.environ.get("VELES_TEST_TPU") == "1"):
+        # explicit oversized-direct requests take the documented
+        # degenerate conv lowering (ops/convolve.py) whose TPU compile
+        # runs tens of minutes; the fallback's correctness is covered on
+        # CPU, and the selector never picks direct at these sizes
+        pytest.skip("degenerate-lowering fallback: CPU-validated only")
     x = rng.normal(size=x_len).astype(np.float32)
     h = rng.normal(size=h_len).astype(np.float32)
     ref = ops.convolve(x, h, impl="reference")

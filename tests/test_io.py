@@ -102,3 +102,32 @@ def test_fallback_without_native(i16_file):
                        capture_output=True, text=True, cwd=repo)
     assert r.returncode == 0, r.stderr
     assert int(r.stdout.strip().splitlines()[-1]) == int(data[:100].sum())
+
+
+def test_empty_file_yields_nothing(tmp_path):
+    p = tmp_path / "empty.bin"
+    p.write_bytes(b"")
+    with hio.FileStream(p, np.int16, chunk_bytes=4096) as fs:
+        assert fs.file_size == 0
+        assert list(fs) == []
+
+
+def test_chunk_larger_than_file(tmp_path, rng):
+    data = rng.normal(size=100).astype(np.float32)
+    p = tmp_path / "small.f32"
+    p.write_bytes(data.tobytes())
+    with hio.FileStream(p, np.float32, chunk_bytes=1 << 20) as fs:
+        chunks = [c.copy() for c in fs]
+    assert len(chunks) == 1
+    np.testing.assert_array_equal(chunks[0], data)
+
+
+def test_next_after_close_is_safe(i16_file):
+    # native: close frees the double buffers; a subsequent next must
+    # refuse (never hand out a freed pointer) — OSError or StopIteration
+    path, _ = i16_file
+    fs = hio.FileStream(path, np.int16, chunk_bytes=4096)
+    next(fs)
+    fs.close()
+    with pytest.raises((OSError, StopIteration)):
+        next(fs)

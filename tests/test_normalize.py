@@ -9,12 +9,19 @@ from veles.simd_tpu.reference import normalize as ref
 
 class TestGolden:
     def test_small_plane(self):
-        """Hand-computed map: {0..255} plane -> exactly [-1, 1]."""
+        """Hand-computed map: {0..255} plane -> [-1, 1] closed interval.
+
+        Endpoint attainment is 1-ulp approximate: TPU division (like the
+        reference's x86 reciprocal path) can land the max at 1 - 2^-24;
+        the closed-interval bound itself is exact (rescale_minmax clips).
+        """
         src = np.array([[0, 128], [255, 64]], np.uint8)
         out = np.asarray(N.normalize2D(src, impl="xla"))
         want = (src.astype(np.float32) - 0) / 127.5 - 1
         np.testing.assert_allclose(out, want, atol=1e-6)
-        assert out.min() == -1.0 and out.max() == 1.0
+        assert out.min() >= -1.0 and out.max() <= 1.0
+        assert out.min() == pytest.approx(-1.0, abs=2e-7)
+        assert out.max() == pytest.approx(1.0, abs=2e-7)
 
     def test_constant_plane_zero_fill(self):
         src = np.full((4, 8), 77, np.uint8)

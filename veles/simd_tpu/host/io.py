@@ -60,6 +60,11 @@ class FileStream:
             self._file = open(self._path, "rb", buffering=0)
             self.file_size = os.fstat(self._file.fileno()).st_size
             self._fallback_buf = bytearray(chunk_bytes)
+        if self.file_size < 0:
+            self.close()
+            raise OSError(
+                f"{self._path!r} is not seekable (FIFO/special file?); "
+                "FileStream needs a regular file")
         if self.file_size % self.dtype.itemsize != 0:
             self.close()
             raise ValueError(

@@ -133,9 +133,13 @@ def _convolve_direct_xla(x, h, reverse=False):
         # correlation orientation here
         lhs = x.reshape(1, 1, n)
         rhs = h.reshape(1, 1, m)
+        # HIGHEST: the direct algorithm's contract is f32 accuracy (the
+        # unrolled path is f32 on the VPU); the TPU default would run
+        # bf16 products through the MXU
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)],
-            dimension_numbers=("NCH", "OIH", "NCH"))
+            dimension_numbers=("NCH", "OIH", "NCH"),
+            precision=jax.lax.Precision.HIGHEST)
         return out.reshape(n_out)
     padded = jnp.pad(x, (m - 1, m - 1))
     acc = jnp.zeros(n_out, jnp.float32)
@@ -155,7 +159,8 @@ def _causal_fir_xla(x, h):
         rhs = h[::-1].reshape(1, 1, m)
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding=[(m - 1, 0)],
-            dimension_numbers=("NCH", "OIH", "NCH"))
+            dimension_numbers=("NCH", "OIH", "NCH"),
+            precision=jax.lax.Precision.HIGHEST)
         return out.reshape(*lead, n)
     pad = [(0, 0)] * (x.ndim - 1) + [(m - 1, 0)]
     padded = jnp.pad(x, pad)

@@ -4,6 +4,14 @@
 ``impl="pallas"`` runs the Cephes polynomial bodies — the exact algorithms
 of the reference's avx_mathfun.h/neon_mathfun.h — as a Pallas VPU kernel.
 ``impl="reference"`` is the float64 NumPy oracle.
+
+Accuracy on TPU hardware (measured v5e, 2026-07-30): XLA's log/exp lower
+to fast hardware approximations — relative error ~5e-5 on well-scaled
+outputs, up to ~3e-4 where log crosses zero — while the Pallas Cephes
+kernels hold ~1 ulp (7e-8 measured) on the same chip, beating the
+reference library's own ~4-ulp contract. Pick ``impl="pallas"`` when the
+reference's accuracy matters; ``xla`` when fusion with surrounding ops
+matters. sin/cos meet ~2e-6 absolute under both impls.
 """
 
 from __future__ import annotations

@@ -39,7 +39,9 @@ def rescale_minmax(src, vmin, vmax):
     sharded twin (parallel.normalize1D_sharded) all call this."""
     diff = (vmax - vmin) * jnp.float32(0.5)
     safe = jnp.where(diff > 0, diff, jnp.float32(1))
-    out = (src - vmin) / safe - 1
+    # clip: TPU's reciprocal-multiply division can land 1 ulp outside
+    # [-1, 1]; the op's contract is a closed interval
+    out = jnp.clip((src - vmin) / safe - 1, -1.0, 1.0)
     return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
 
 

@@ -46,7 +46,8 @@ def convolve_sharded(x, h, mesh, axis="seq", *, boundary="zero"):
         rhs = h[::-1].reshape(1, 1, -1)
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding="VALID",
-            dimension_numbers=("NCH", "OIH", "NCH"))
+            dimension_numbers=("NCH", "OIH", "NCH"),
+            precision=jax.lax.Precision.HIGHEST)
         return out.reshape(-1)
 
     fn = halo_map(local, mesh, axis, left=m - 1, boundary=boundary,

@@ -157,14 +157,58 @@ def bench_flagship(scale=1):
         out = pipe(c, fir, w)
         return c + jnp.float32(1e-9) * jnp.sum(out)
 
-    dt = chain_time(step, sig, iters=1024, null_carry=sig[:1, :8])
+    # 4096 iters: the causal_fir pipeline got fast enough that 1024
+    # chained steps no longer dominate the tunnel RTT floor
+    dt = chain_time(step, sig, iters=4096, null_carry=sig[:1, :8])
     return {"metric": f"flagship_pipeline_b{batch}_n{n}",
             "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
             "vs_baseline": None}
 
 
+def bench_feed_io(scale=1):
+    """Disk -> staging -> device loader throughput, host wall clock: the
+    three-stage feed path (C++ prefetch reader thread, pooled aligned
+    staging with int16->float32 conversion, async device_put). Measures
+    pipeline overhead — the file rides the page cache, as a hot training
+    input would."""
+    import os
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from veles.simd_tpu.host import io as hio
+    from veles.simd_tpu.host.feed import FeedPipeline
+
+    batch, n, n_batches = 64, int(16384 * scale), 32
+    rng = np.random.default_rng(0)
+    data = rng.integers(-32768, 32767, size=(n_batches, batch, n),
+                        dtype=np.int16)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench.i16")
+        data.tofile(path)
+
+        def one_pass():
+            last = None
+            src = hio.file_batches(path, (batch, n), np.int16)
+            with FeedPipeline(src, dtype=np.float32, depth=2) as feed:
+                for dev in feed:
+                    last = dev
+            jax.block_until_ready(last)
+
+        one_pass()                      # warm: native build, pools, cache
+        t0 = time.perf_counter()
+        one_pass()
+        dt = time.perf_counter() - t0
+    total = n_batches * batch * n
+    return {"metric": f"feed_io_b{batch}_n{n}",
+            "value": round(total / dt / 1e6, 1), "unit": "MSamples/s",
+            "vs_baseline": None}
+
+
 CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
-           bench_batched_pipeline, bench_flagship)
+           bench_batched_pipeline, bench_flagship, bench_feed_io)
 
 
 def run_secondary(stream, scale=None):
