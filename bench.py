@@ -31,7 +31,7 @@ def bench_matmul_4096():
 
     on_tpu = jax.default_backend() == "tpu"
     n = 4096 if on_tpu else 256  # CPU smoke fallback; driver runs on TPU
-    iters = 64 if on_tpu else 4
+    iters = 1024 if on_tpu else 4  # total >> RTT floor so drift can't bias
     k1, k2 = jax.random.split(jax.random.key(0))
     a = jax.random.normal(k1, (n, n), jnp.float32)
     b = jax.random.normal(k2, (n, n), jnp.float32) / jnp.float32(np.sqrt(n))
@@ -39,8 +39,17 @@ def bench_matmul_4096():
     from veles.simd_tpu import ops
     from veles.simd_tpu.utils.benchlib import chain_time
 
-    dt = chain_time(lambda c: ops.matrix_multiply(c, b), a, iters)
-    gflops = 2 * n ** 3 / dt / 1e9
+    # Chip capability drifts ~2x run-to-run on the shared tunnel; three
+    # spaced attempt groups (compiled once, best paired-floor difference)
+    # make the report repeatable to ~4%. Tiny null carry: the floor must
+    # capture only dispatch/scan/RTT overhead — a full-size null chain
+    # would also cancel the HBM pass the matmul legitimately pays,
+    # inflating GFLOPS past peak.
+    best_dt = chain_time(
+        lambda c: ops.matrix_multiply(c, b), a, iters, reps=3,
+        null_carry=a[:8, :8], attempts=3 if on_tpu else 1,
+        attempt_gap_s=2.0)
+    gflops = 2 * n ** 3 / best_dt / 1e9
     return {
         "metric": f"matrix_multiply_f32_n{n}",
         "value": round(gflops, 1),

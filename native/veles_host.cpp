@@ -345,6 +345,8 @@ void stream_reader_main(Stream* s) {
     bool failed = at_end && ferror(s->f);
     {
       std::lock_guard<std::mutex> lock(s->mu);
+      if (s->stop) break;  // close() raced the fread: never publish a
+                           // chunk whose buffer is about to be freed
       if (n > 0 && !failed) {
         s->len[fill] = n;
         s->ready = fill;
@@ -405,11 +407,12 @@ VH_API int vh_stream_next(int64_t handle, void** data, int64_t* nbytes) {
     *nbytes = 0;
     return -1;
   }
-  s->cv_ready.wait(lock, [&] { return s->ready != -1 || s->done; });
-  if (s->ready == -1) {
+  s->cv_ready.wait(lock,
+                   [&] { return s->ready != -1 || s->done || s->stop; });
+  if (s->ready == -1 || s->stop) {  // re-check: close() may have raced in
     *data = nullptr;
     *nbytes = 0;
-    return s->error ? -1 : 0;
+    return (s->error || s->stop) ? -1 : 0;
   }
   s->held = s->ready;   // previous held buffer becomes refillable
   s->ready = -1;
@@ -435,6 +438,7 @@ VH_API int vh_stream_close(int64_t handle) {
     s->stop = true;
     s->ready = -1;  // pending chunk is void once buffers are freed below
     s->cv_free.notify_one();
+    s->cv_ready.notify_all();  // wake any consumer blocked in next()
   }
   if (s->worker.joinable()) s->worker.join();
   fclose(s->f);

@@ -105,3 +105,17 @@ class TestContracts:
                                  impl="xla")
         with pytest.raises(ValueError):
             ref.normalize2D_minmax(10, 5, np.zeros((2, 2), np.uint8))
+
+
+class TestPrecomputedStatsPassthrough:
+    def test_out_of_range_samples_not_clipped(self):
+        # two-pass API with caller stats (normalize.c:466-491): samples
+        # outside [vmin, vmax] must map outside [-1, 1], as in C — the
+        # closed-interval clip applies only when stats derive from src
+        src = np.array([[0, 128], [255, 64]], np.uint8)
+        for impl in ("reference", "xla"):
+            out = np.asarray(N.normalize2D_minmax(
+                np.float32(0), np.float32(127.5), src, impl=impl))
+            want = src.astype(np.float64) / (127.5 / 2) - 1
+            np.testing.assert_allclose(out, want, atol=1e-5)
+        assert out.max() > 1.0  # 255 maps to 3.0, untouched

@@ -32,16 +32,22 @@ def _minmax1D_xla(src):
     return jnp.min(src, axis=-1), jnp.max(src, axis=-1)
 
 
-def rescale_minmax(src, vmin, vmax):
+def rescale_minmax(src, vmin, vmax, *, clip=False):
     """The [-1, 1] affine rescale given per-signal broadcastable min/max;
     min == max -> zero fill (normalize.c:44-47; jnp.where keeps it
     jittable). The single home of the policy — the 1-D/2-D ops and the
-    sharded twin (parallel.normalize1D_sharded) all call this."""
+    sharded twin (parallel.normalize1D_sharded) all call this.
+
+    ``clip=True`` closes the interval: TPU's reciprocal-multiply division
+    can land the extremes 1 ulp outside [-1, 1]. Only correct when
+    vmin/vmax are derived from ``src`` itself — with caller-provided
+    stats (normalize2D_minmax), out-of-range samples must pass through
+    unclamped, as in the reference (normalize.c:466-491)."""
     diff = (vmax - vmin) * jnp.float32(0.5)
     safe = jnp.where(diff > 0, diff, jnp.float32(1))
-    # clip: TPU's reciprocal-multiply division can land 1 ulp outside
-    # [-1, 1]; the op's contract is a closed interval
-    out = jnp.clip((src - vmin) / safe - 1, -1.0, 1.0)
+    out = (src - vmin) / safe - 1
+    if clip:
+        out = jnp.clip(out, -1.0, 1.0)
     return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
 
 
@@ -55,8 +61,12 @@ def _normalize2D_minmax_xla(vmin, vmax, src):
 
 @jax.jit
 def _normalize2D_xla(src):
+    # stats derive from src itself -> closed-interval clip is correct
     vmin, vmax = _minmax2D_xla(src)
-    return _normalize2D_minmax_xla(vmin, vmax, src)
+    src = jnp.asarray(src, jnp.float32)
+    return rescale_minmax(src, jnp.asarray(vmin, jnp.float32)[..., None, None],
+                          jnp.asarray(vmax, jnp.float32)[..., None, None],
+                          clip=True)
 
 
 @jax.jit
@@ -64,7 +74,7 @@ def _normalize1D_xla(src):
     src = jnp.asarray(src, jnp.float32)
     vmin = jnp.min(src, axis=-1, keepdims=True)
     vmax = jnp.max(src, axis=-1, keepdims=True)
-    return rescale_minmax(src, vmin, vmax)
+    return rescale_minmax(src, vmin, vmax, clip=True)
 
 
 def normalize1D(src, *, impl=None):

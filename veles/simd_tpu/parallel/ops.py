@@ -231,7 +231,7 @@ def normalize1D_sharded(x, *, mesh, axis="seq", batch_axis=None):
     def local(x_loc):
         vmin = jax.lax.pmin(jnp.min(x_loc, axis=-1, keepdims=True), axis)
         vmax = jax.lax.pmax(jnp.max(x_loc, axis=-1, keepdims=True), axis)
-        return rescale_minmax(x_loc, vmin, vmax)
+        return rescale_minmax(x_loc, vmin, vmax, clip=True)
 
     spec = P(batch_axis, axis)
     return shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec)(

@@ -37,7 +37,8 @@ def make_chain(step_fn, iters: int):
 
 
 def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
-                on_floor: str = "raise", null_carry=None) -> dict:
+                on_floor: str = "raise", null_carry=None,
+                attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
     """Per-step seconds for each named step fn, RTT-corrected.
 
     ``steps`` maps name -> (carry -> carry). All configs (plus an implicit
@@ -74,31 +75,46 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
         if not math.isfinite(value):
             raise RuntimeError(f"non-finite checksum from {name}: {value}")
 
-    best = {name: float("inf") for name in chains}
-    for _ in range(reps):
-        for name, chain in chains.items():
-            t0 = time.perf_counter()
-            float(chain(carries[name]))
-            best[name] = min(best[name], time.perf_counter() - t0)
+    # ``attempts`` spaced groups of ``reps`` reuse the compiled chains —
+    # cheap resilience against multi-second chip/tunnel state drift
+    # (observed ~2x swings) without recompiling anything.
+    totals = {name: [] for name in chains}
+    for attempt in range(max(attempts, 1)):
+        if attempt and attempt_gap_s > 0:
+            time.sleep(attempt_gap_s)
+        for _ in range(reps):
+            for name, chain in chains.items():
+                t0 = time.perf_counter()
+                float(chain(carries[name]))
+                totals[name].append(time.perf_counter() - t0)
 
-    floor = best.pop("__null__")
+    # The floor drifts between reps (tunnel scheduling); subtracting the
+    # global-min floor from the global-min total mixes two different
+    # moments and can over-correct past hardware peak. Pair each rep's
+    # floor with that rep's totals, then take the best PAIRED difference.
+    floors = totals.pop("__null__")
     out = {}
-    for name, total in best.items():
-        if total <= floor * 1.05:
-            msg = (f"config '{name}' ({total * 1e3:.1f} ms) is "
+    for name, series in totals.items():
+        diffs = [t - f for t, f in zip(series, floors)]
+        best_total, best_floor = min(series), min(floors)
+        best_diff = min(diffs)
+        if best_total <= best_floor * 1.05 or best_diff <= 0:
+            msg = (f"config '{name}' ({best_total * 1e3:.1f} ms) is "
                    f"indistinguishable from the RTT floor "
-                   f"({floor * 1e3:.1f} ms); raise iters so device time "
-                   f"dominates — a corrected rate here would be noise")
+                   f"({best_floor * 1e3:.1f} ms); raise iters so device "
+                   f"time dominates — a corrected rate here would be noise")
             if on_floor == "raise":
                 raise RuntimeError(msg)
             out[name] = float("nan")
         else:
-            out[name] = (total - floor) / iters
+            out[name] = best_diff / iters
     return out
 
 
 def chain_time(step_fn, carry, iters: int, reps: int = 3, *,
-               null_carry=None) -> float:
+               null_carry=None, attempts: int = 1,
+               attempt_gap_s: float = 0.0) -> float:
     """Single-config convenience wrapper over chain_times."""
     return chain_times({"_": step_fn}, carry, iters, reps,
-                       null_carry=null_carry)["_"]
+                       null_carry=null_carry, attempts=attempts,
+                       attempt_gap_s=attempt_gap_s)["_"]
