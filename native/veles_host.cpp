@@ -312,6 +312,7 @@ struct Stream {
   bool done = false;   // reader thread exited (EOF or error)
   bool error = false;
   bool stop = false;
+  bool closing = false;  // one thread has claimed the close sequence
   int64_t file_size = -1;
   std::thread worker;
   std::mutex mu;
@@ -434,7 +435,12 @@ VH_API int vh_stream_close(int64_t handle) {
   if (!s) return -1;
   {
     std::lock_guard<std::mutex> lock(s->mu);
-    if (!s->f) return 0;  // already closed
+    // claim the close atomically: a concurrent second close (e.g.
+    // explicit close racing a GC finalizer on another thread) must not
+    // reach the join/fclose/free sequence twice. s->f stays non-null
+    // until after the join — the reader dereferences it lock-free.
+    if (s->closing || !s->f) return 0;
+    s->closing = true;
     s->stop = true;
     s->ready = -1;  // pending chunk is void once buffers are freed below
     s->cv_free.notify_one();

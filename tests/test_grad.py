@@ -1,0 +1,110 @@
+"""Differentiability of the XLA compute path (framework extension).
+
+The reference is a C library with no autodiff; here every signal op is a
+functional JAX transform, so gradients through filtering, wavelets,
+normalization, and the composed flagship model must exist and be correct
+(checked against central finite differences). Pallas kernels are
+forward-only by design — the xla impl is the training path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.models import SignalPipeline
+
+
+def _fd_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.ravel()
+    gf = g.ravel()
+    for i in range(flat.size):
+        up, down = flat.copy(), flat.copy()
+        up[i] += eps
+        down[i] -= eps
+        gf[i] = (f(up.reshape(x.shape)) - f(down.reshape(x.shape))) / (2 * eps)
+    return g
+
+
+def _check(f, x, atol=2e-2):
+    got = np.asarray(jax.grad(lambda v: f(v))(jnp.asarray(x)))
+    want = _fd_grad(lambda v: float(f(jnp.asarray(v))), x)
+    np.testing.assert_allclose(got, want, atol=atol)
+
+
+def test_grad_through_convolve(rng):
+    x = rng.normal(size=24).astype(np.float32)
+    h = jnp.asarray(rng.normal(size=5).astype(np.float32))
+    _check(lambda v: jnp.sum(ops.convolve(v, h, algorithm="direct") ** 2), x)
+
+
+def test_grad_through_causal_fir_wrt_taps(rng):
+    x = jnp.asarray(rng.normal(size=(2, 32)).astype(np.float32))
+    h = rng.normal(size=7).astype(np.float32)
+    _check(lambda taps: jnp.sum(ops.causal_fir(x, taps) ** 2), h)
+
+
+def test_grad_through_wavelet_apply(rng):
+    x = rng.normal(size=32).astype(np.float32)
+
+    def f(v):
+        hi, lo = ops.wavelet_apply(v, "daubechies", 4, impl="xla")
+        return jnp.sum(hi ** 2) + jnp.sum(jnp.abs(lo))
+
+    _check(f, x)
+
+
+def test_grad_through_stationary_wavelet(rng):
+    x = rng.normal(size=32).astype(np.float32)
+
+    def f(v):
+        hi, lo = ops.stationary_wavelet_apply(v, "daubechies", 4, 2,
+                                              impl="xla")
+        return jnp.sum(hi * lo)
+
+    _check(f, x)
+
+
+def test_grad_through_normalize(rng):
+    # min/max subgradients: keep samples well-separated so the argmin/
+    # argmax are stable under the finite-difference eps
+    x = (np.arange(16, dtype=np.float32) * 0.5
+         + rng.normal(size=16).astype(np.float32) * 0.01)
+
+    def f(v):
+        return jnp.sum(ops.normalize1D(v, impl="xla") ** 3)
+
+    _check(f, x)
+
+
+def test_grad_through_flagship_pipeline(rng):
+    sig = jnp.asarray(rng.normal(size=(2, 64)).astype(np.float32))
+    fir = jnp.asarray(rng.normal(size=9).astype(np.float32))
+    w = rng.normal(size=(3 * 64, 4)).astype(np.float32) * 0.1
+    pipe = SignalPipeline()
+
+    def f(weights):
+        return jnp.sum(pipe(sig, fir, weights) ** 2)
+
+    _check(f, w, atol=5e-2)
+
+
+def test_grad_through_matrix_ops(rng):
+    a = rng.normal(size=(4, 6)).astype(np.float32)
+    b = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
+    _check(lambda m: jnp.sum(ops.matrix_multiply(
+        m, b, precision=jax.lax.Precision.HIGHEST) ** 2), a)
+
+
+def test_pallas_impls_are_forward_only():
+    # documented contract: hand kernels serve inference/throughput; the
+    # xla impl is the training path
+    x = jnp.linspace(0.1, 1.0, 256)
+
+    def f(v):
+        return jnp.sum(ops.sin_psv(v.astype(jnp.float32), impl="pallas"))
+
+    with pytest.raises(Exception):
+        jax.grad(f)(x)

@@ -51,22 +51,24 @@ def rescale_minmax(src, vmin, vmax, *, clip=False):
     return jnp.where(diff > 0, out, jnp.zeros_like(out)).astype(jnp.float32)
 
 
-@jax.jit
-def _normalize2D_minmax_xla(vmin, vmax, src):
+def _rescale2D(vmin, vmax, src, clip):
     src = jnp.asarray(src, jnp.float32)
     vmin = jnp.asarray(vmin, jnp.float32)[..., None, None]
     vmax = jnp.asarray(vmax, jnp.float32)[..., None, None]
-    return rescale_minmax(src, vmin, vmax)
+    return rescale_minmax(src, vmin, vmax, clip=clip)
+
+
+@jax.jit
+def _normalize2D_minmax_xla(vmin, vmax, src):
+    # caller-provided stats: out-of-range samples pass through unclamped
+    return _rescale2D(vmin, vmax, src, clip=False)
 
 
 @jax.jit
 def _normalize2D_xla(src):
     # stats derive from src itself -> closed-interval clip is correct
     vmin, vmax = _minmax2D_xla(src)
-    src = jnp.asarray(src, jnp.float32)
-    return rescale_minmax(src, jnp.asarray(vmin, jnp.float32)[..., None, None],
-                          jnp.asarray(vmax, jnp.float32)[..., None, None],
-                          clip=True)
+    return _rescale2D(vmin, vmax, src, clip=True)
 
 
 @jax.jit

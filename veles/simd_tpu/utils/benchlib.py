@@ -90,14 +90,17 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
 
     # The floor drifts between reps (tunnel scheduling); subtracting the
     # global-min floor from the global-min total mixes two different
-    # moments and can over-correct past hardware peak. Pair each rep's
-    # floor with that rep's totals, then take the best PAIRED difference.
+    # moments and can over-correct past hardware peak. Correct the rep
+    # with the best total by ITS OWN adjacent floor reading — selecting
+    # on the total alone keeps the paired floor sample unbiased (a
+    # min-over-paired-diffs would preferentially pick high-floor
+    # outliers and inflate rates again).
     floors = totals.pop("__null__")
     out = {}
     for name, series in totals.items():
-        diffs = [t - f for t, f in zip(series, floors)]
-        best_total, best_floor = min(series), min(floors)
-        best_diff = min(diffs)
+        idx = min(range(len(series)), key=series.__getitem__)
+        best_total, best_floor = series[idx], min(floors)
+        best_diff = best_total - floors[idx]
         if best_total <= best_floor * 1.05 or best_diff <= 0:
             msg = (f"config '{name}' ({best_total * 1e3:.1f} ms) is "
                    f"indistinguishable from the RTT floor "

@@ -93,7 +93,7 @@ def default_configs():
             xw, "daubechies", 8, "periodic"),
         lambda c: jnp.concatenate(
             ops.wavelet_apply(c, "daubechies", 8, "periodic", impl="xla")),
-        xwj, 2048))
+        xwj, 8192))  # 2048-step chains fell under the tunnel RTT floor
 
     # SWT db8 level 3 (output scaled so the chained carry stays bounded —
     # the lowpass gain is sqrt(2) per application)
