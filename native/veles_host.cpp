@@ -447,11 +447,17 @@ VH_API int vh_stream_close(int64_t handle) {
     s->cv_ready.notify_all();  // wake any consumer blocked in next()
   }
   if (s->worker.joinable()) s->worker.join();
-  fclose(s->f);
-  s->f = nullptr;
-  free(s->buf[0]);
-  free(s->buf[1]);
-  s->buf[0] = s->buf[1] = nullptr;
+  {
+    // teardown under the mutex: vh_stream_next reads s->f under s->mu,
+    // so these writes must be ordered with it (the join above already
+    // guarantees the reader thread is gone)
+    std::lock_guard<std::mutex> lock(s->mu);
+    fclose(s->f);
+    s->f = nullptr;
+    free(s->buf[0]);
+    free(s->buf[1]);
+    s->buf[0] = s->buf[1] = nullptr;
+  }
   return 0;
 }
 

@@ -76,6 +76,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("suites", nargs="*",
                     help="bare suite names (default: all)")
+    # On the TPU tunnel, compile-heavy suites (convolve/correlate shape
+    # sweeps) legitimately run 10+ minutes — each fresh jit shape compiles
+    # server-side while the client blocks. Size --timeout accordingly in
+    # VELES_TEST_TPU=1 mode, or prefer one single-process pytest run
+    # (shares the compile cache; ~12 min total).
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-suite wall-clock limit in seconds")
     ap.add_argument("--log", default=os.path.join(REPO, "tests.log"))

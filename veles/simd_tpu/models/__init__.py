@@ -16,3 +16,4 @@ are first-class, jittable, batched, and mesh-shardable:
 from veles.simd_tpu.models.matched_filter import MatchedFilterDetector  # noqa: F401
 from veles.simd_tpu.models.denoiser import WaveletDenoiser  # noqa: F401
 from veles.simd_tpu.models.pipeline import SignalPipeline  # noqa: F401
+from veles.simd_tpu.models.spectral import SpectralPeakAnalyzer  # noqa: F401

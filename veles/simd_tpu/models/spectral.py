@@ -1,0 +1,104 @@
+"""Spectral peak analyzer: batched power spectra + tone extraction.
+
+The frequency-domain composition the reference's pieces imply but never
+assemble (convolve.c's FFT machinery + detect_peaks.c): Welch-averaged
+periodograms computed as one batched rfft over overlapped windows (the
+overlap-save block idiom pointed at spectral estimation), then
+fixed-capacity peak extraction over the spectrum with parabolic
+interpolation for sub-bin frequency accuracy. TPU-shaped: windows
+materialize via strided reshapes (never a gather), the FFT is one batched
+``jnp.fft.rfft``, and peak compaction rides the one-hot MXU path
+(ops.detect_peaks_topk).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu import ops
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop", "capacity"))
+def _analyze(signals, window, nfft, hop, capacity):
+    x = jnp.asarray(signals, jnp.float32)
+    n = x.shape[-1]
+    n_frames = 1 + (n - nfft) // hop
+    if nfft % hop == 0:
+        # gather-free overlapped framing in O(nfft/hop) ops (BASELINE.md
+        # layout rule 2, the convolve.py body/halo idiom): cut the signal
+        # into hop-sized blocks once, then each frame is nfft/hop
+        # consecutive blocks — k shifted views of the block matrix,
+        # concatenated on the last axis.
+        k = nfft // hop
+        n_blocks = n // hop
+        blocks = x[..., :n_blocks * hop].reshape(*x.shape[:-1],
+                                                 n_blocks, hop)
+        frames = jnp.concatenate(
+            [blocks[..., j:j + n_frames, :] for j in range(k)],
+            axis=-1)                             # (..., F, nfft)
+    else:
+        # irregular hop: per-frame slices (O(n_frames) traced ops — fine
+        # for short signals, avoid for long ones)
+        frames = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(x, int(s), nfft, axis=-1)
+            for s in np.arange(n_frames) * hop], axis=-2)
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    power = jnp.mean(jnp.abs(spec) ** 2, axis=-2)  # Welch average
+    power = power / (jnp.sum(window ** 2) * nfft)
+
+    logp = jnp.log(power + jnp.float32(1e-20))
+    positions, values, count = ops.detect_peaks_topk(
+        logp, ops.EXTREMUM_TYPE_MAXIMUM, k=capacity, impl="xla")
+
+    # parabolic interpolation around each peak bin for sub-bin frequency:
+    # delta = (l - r) / (2*(l - 2c + r)), one-hot reads (no gather)
+    nbins = logp.shape[-1]
+    safe = jnp.clip(positions, 1, nbins - 2)
+    onehot = jax.nn.one_hot(safe, nbins, dtype=jnp.float32)
+    read = lambda off: jnp.einsum(
+        "...kb,...b->...k",
+        jnp.roll(onehot, off, axis=-1), logp,
+        precision=jax.lax.Precision.HIGHEST)
+    c, left, right = read(0), read(-1), read(1)
+    denom = left - 2 * c + right
+    delta = jnp.where(jnp.abs(denom) > 1e-12,
+                      (left - right) / (2 * denom), 0.0)
+    freq_bins = jnp.where(positions >= 0,
+                          safe.astype(jnp.float32) + delta, -1.0)
+    return power, freq_bins, values, count
+
+
+class SpectralPeakAnalyzer:
+    """Find the strongest tones in batched signals.
+
+        spa = SpectralPeakAnalyzer(nfft=512, capacity=4)
+        power, freq_bins, logp, counts = spa(signals)  # freqs in bins
+
+    ``nfft``: window/FFT length (Hann window); ``hop`` defaults to
+    nfft // 2 (50% overlap Welch); ``capacity``: tones kept per signal,
+    strongest first. ``freq_bins`` are sub-bin-accurate via parabolic
+    interpolation; multiply by ``fs / nfft`` for Hz.
+    """
+
+    def __init__(self, *, nfft: int = 512, hop: int | None = None,
+                 capacity: int = 4):
+        if nfft < 8:
+            raise ValueError("nfft must be >= 8")
+        self.nfft = int(nfft)
+        self.hop = int(hop) if hop is not None else self.nfft // 2
+        if self.hop < 1:
+            raise ValueError("hop must be >= 1")
+        self.capacity = int(capacity)
+        self.window = jnp.asarray(np.hanning(self.nfft).astype(np.float32))
+
+    def __call__(self, signals):
+        signals = jnp.asarray(signals)
+        if signals.shape[-1] < self.nfft:
+            raise ValueError(
+                f"signal length {signals.shape[-1]} < nfft {self.nfft}")
+        return _analyze(signals, self.window, self.nfft, self.hop,
+                        self.capacity)
