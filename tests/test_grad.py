@@ -7,6 +7,8 @@ normalization, and the composed flagship model must exist and be correct
 forward-only by design — the xla impl is the training path.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -96,9 +98,6 @@ def test_grad_through_matrix_ops(rng):
     b = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
     _check(lambda m: jnp.sum(ops.matrix_multiply(
         m, b, precision=jax.lax.Precision.HIGHEST) ** 2), a)
-
-
-import os
 
 
 @pytest.mark.skipif(os.environ.get("VELES_TEST_TPU") == "1",

@@ -146,15 +146,19 @@ class TestSpectralPeakAnalyzer:
             spa(np.zeros(100, np.float32))
 
     def test_irregular_hop_matches_regular_framing_path(self, rng):
-        # both framing formulations must agree where they overlap
+        # both framing formulations must agree where they overlap; a
+        # deterministic tone (not a noise argmax, which has no stable
+        # dominant bin) makes that comparison seed-independent
         from veles.simd_tpu.models import SpectralPeakAnalyzer
 
-        x = rng.normal(size=2048).astype(np.float32)
+        t = np.arange(2048, dtype=np.float32)
+        x = (np.sin(2 * np.pi * 40.0 / 256.0 * t)
+             + 0.01 * rng.normal(size=2048)).astype(np.float32)
         a = SpectralPeakAnalyzer(nfft=256, hop=128, capacity=2)   # fast path
         b = SpectralPeakAnalyzer(nfft=256, hop=127, capacity=2)   # loop path
         pa, fa, _, _ = a(x)
         pb, fb, _, _ = b(x)
         assert pa.shape == pb.shape
-        # same dominant bins despite slightly different Welch frames
-        np.testing.assert_allclose(np.asarray(fa)[0], np.asarray(fb)[0],
-                                   atol=1.0)
+        # same dominant bin (40) despite slightly different Welch frames
+        np.testing.assert_allclose(np.asarray(fa)[0], 40.0, atol=0.5)
+        np.testing.assert_allclose(np.asarray(fb)[0], 40.0, atol=0.5)

@@ -53,6 +53,7 @@ MODULES = [
     "veles.simd_tpu.pallas.wavelet",
     "veles.simd_tpu.utils.benchlib",
     "veles.simd_tpu.utils.checkpoint",
+    "veles.simd_tpu.utils.export",
     "veles.simd_tpu.utils.speedup",
     "veles.simd_tpu.utils.profiling",
 ]

@@ -1,0 +1,183 @@
+"""AOT export: compiled-op artifacts that ship without Python sources.
+
+TPU-native rebirth of the reference's cross-build deployment story. The
+reference cross-compiles `libSimd.so` for a foreign target with the
+Android NDK (/root/reference/android/Android.mk.in:1-30, android.ac) — a
+binary artifact built on one machine, executed on another, no toolchain
+at the destination. The XLA analogue is `jax.export`: lower a jitted op
+to serialized StableHLO on any host (including a CPU-only build box, via
+``platforms=["tpu"]`` cross-lowering), write the bytes to disk, and
+reload + run them later with no access to this package's op code — the
+artifact carries the whole computation.
+
+Three layers, mirroring the reference's build artifacts:
+
+- single op  <->  one object file:   ``save_op`` / ``load_op``
+- bundle     <->  libSimd.so:        ``save_bundle`` / ``load_bundle``
+  (a directory of serialized ops + a JSON manifest of signatures)
+- symbolic shapes <-> the reference's length-generic C loops:
+  ``sym`` builds shape-polymorphic argument specs ("n", "b, 2*n") so one
+  artifact serves every length, the way one compiled C function does.
+
+Handles in the reference bake shapes at `*_initialize` time
+(src/convolve.c:328-366); a static-shape export is exactly that handle,
+made durable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import export as _jexport
+
+_MANIFEST = "manifest.json"
+_SUFFIX = ".stablehlo"
+
+
+def sym_scope():
+    """A fresh symbolic-dimension scope, shared by related :func:`sym`
+    specs of one export (dimensions from different scopes cannot mix)."""
+    return _jexport.SymbolicScope()
+
+
+def sym(shape_spec: str, dtype=jnp.float32, *, scope=None):
+    """Shape-polymorphic argument spec for exporting length-generic ops.
+
+    ``sym("n")`` / ``sym("b, n")`` name symbolic dimensions; one exported
+    artifact then accepts any concrete size, like the reference's C loops
+    accept any ``length`` (e.g. inc/simd/mathfun.h:142-204).
+
+    Multi-argument exports must share one scope — pass the same
+    ``scope=sym_scope()`` to every spec, or use :func:`syms`.
+    """
+    dims = _jexport.symbolic_shape(shape_spec, scope=scope)
+    return jax.ShapeDtypeStruct(dims, dtype)
+
+
+def syms(*shape_specs: str, dtype=jnp.float32):
+    """Specs for a multi-argument symbolic export, built in one shared
+    scope so their dimensions may mix: ``syms("m, k", "k, n")``."""
+    scope = sym_scope()
+    return tuple(sym(s, dtype, scope=scope) for s in shape_specs)
+
+
+def export_op(fn, example_args, *, platforms=None):
+    """Lower ``fn`` at ``example_args`` (arrays or ShapeDtypeStructs, may
+    be symbolic via :func:`sym`) into a ``jax.export.Exported``.
+
+    ``platforms`` lists lowering targets, e.g. ``["cpu", "tpu"]`` — the
+    cross-compile axis the NDK provided (lower for TPU on a machine that
+    has none). Default: the current backend only.
+    """
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = tuple(platforms)
+    return _jexport.export(jax.jit(fn), **kwargs)(*example_args)
+
+
+def save_op(path, fn, example_args, *, platforms=None) -> str:
+    """Serialize one op to ``path``. Returns the absolute path."""
+    exported = export_op(fn, example_args, platforms=platforms)
+    path = os.path.abspath(str(path))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    return path
+
+
+def load_op(path):
+    """Deserialize an op saved by :func:`save_op` into a callable.
+
+    The returned callable runs the stored StableHLO directly — none of
+    this package's op implementations are consulted.
+    """
+    with open(os.path.abspath(str(path)), "rb") as f:
+        exported = _jexport.deserialize(bytearray(f.read()))
+
+    def call(*args):
+        return exported.call(*args)
+
+    call.exported = exported
+    call.__name__ = getattr(exported, "fun_name", "exported_op")
+    return call
+
+
+def save_bundle(path, ops, *, platforms=None) -> str:
+    """Write a deployment bundle: ``{name: (fn, example_args)}`` → a
+    directory of ``<name>.stablehlo`` files plus a signature manifest.
+
+    The bundle is the `libSimd.so` of this framework: a single shippable
+    directory with every op a deployment needs, loadable anywhere JAX
+    runs (subject to the lowered ``platforms``).
+    """
+    path = os.path.abspath(str(path))
+    os.makedirs(path, exist_ok=True)
+    manifest = {"format": 1, "platforms": [], "ops": {}}
+    lowered = set()
+    for name, (fn, example_args) in ops.items():
+        exported = export_op(fn, example_args, platforms=platforms)
+        lowered.update(exported.platforms)
+        fname = name + _SUFFIX
+        with open(os.path.join(path, fname), "wb") as f:
+            f.write(exported.serialize())
+        manifest["ops"][name] = {
+            "file": fname,
+            "in_avals": [str(a) for a in exported.in_avals],
+            "out_avals": [str(a) for a in exported.out_avals],
+            "platforms": list(exported.platforms),
+        }
+    manifest["platforms"] = sorted(lowered)
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    return path
+
+
+def load_bundle(path):
+    """Load a bundle directory into ``{name: callable}``."""
+    path = os.path.abspath(str(path))
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    return {name: load_op(os.path.join(path, entry["file"]))
+            for name, entry in manifest["ops"].items()}
+
+
+def standard_bundle(path, *, length=4096, batch=128, n=1024,
+                    platforms=None) -> str:
+    """Export the framework's flagship ops at deployment shapes — the
+    default "product build". Covers the reference's headline API rows
+    (SURVEY §2 checklist): matmul, auto-selected convolve, DWT, SWT,
+    normalize2D, detect_peaks, and the transcendental quartet.
+    """
+    from veles.simd_tpu import ops as O
+
+    f32 = jnp.float32
+    a = jax.ShapeDtypeStruct
+
+    h_len = 127
+    bundle = {
+        "matrix_multiply": (
+            O.matrix_multiply, (a((n, n), f32), a((n, n), f32))),
+        "convolve": (
+            lambda x, h: O.convolve(x, h),
+            (a((length,), f32), a((h_len,), f32))),
+        "wavelet_apply_db8": (
+            lambda x: O.wavelet_apply(x, "daubechies", 8),
+            (a((length,), f32),)),
+        "stationary_wavelet_db8_l1": (
+            lambda x: O.stationary_wavelet_apply(
+                x, "daubechies", 8, level=1),
+            (a((length,), f32),)),
+        "normalize2D": (
+            O.normalize2D, (a((batch, length), jnp.uint8),)),
+        "detect_peaks_batch": (
+            lambda x: O.detect_peaks_fixed(x, capacity=64),
+            (a((batch, length), f32),)),
+        "sin_psv": (O.sin_psv, (a((length,), f32),)),
+        "cos_psv": (O.cos_psv, (a((length,), f32),)),
+        "log_psv": (O.log_psv, (a((length,), f32),)),
+        "exp_psv": (O.exp_psv, (a((length,), f32),)),
+    }
+    return save_bundle(path, bundle, platforms=platforms)
