@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Real-time streaming pipeline demo: FIR smoothing -> peak detection
+over chunks, with a mid-stream checkpoint/resume.
+
+    python examples/stream_peaks.py
+
+A long noisy tone burst arrives in 512-sample chunks. Each chunk is
+smoothed by a streaming causal FIR (state carries the filter history
+across chunk boundaries) and scanned for peaks (state carries the last
+two samples, so a peak on a chunk boundary is still found). Halfway
+through, the stream state is checkpointed and restored — the resumed
+stream produces byte-identical results to an uninterrupted run.
+"""
+
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from veles.simd_tpu import ops
+    from veles.simd_tpu.utils import checkpoint
+
+    fs, n, chunk = 8000.0, 8192, 512
+    t = np.arange(n) / fs
+    rng = np.random.default_rng(1)
+    x = (np.sin(2 * np.pi * 30.0 * t) * (t > 0.4)
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    h = np.ones(32, np.float32) / 32.0          # moving-average smoother
+
+    fir = ops.fir_stream_init(h)
+    pk = ops.peaks_stream_init()
+    peaks = []
+    for i in range(0, n, chunk):
+        if i == n // 2:                          # mid-stream checkpoint
+            d = tempfile.mkdtemp()
+            checkpoint.save(d, {"fir": fir._asdict(), "pk": pk._asdict()})
+            st = checkpoint.restore(d)
+            fir = ops.FirStreamState(**st["fir"])
+            pk = ops.PeaksStreamState(**st["pk"])
+            print(f"checkpoint/resume at sample {i}")
+        fir, y = ops.fir_stream_step(fir, x[i:i + chunk], h)
+        pk, (pos, val, count) = ops.peaks_stream_step(
+            pk, y, ops.EXTREMUM_TYPE_MAXIMUM, capacity=chunk)
+        k = int(count)
+        peaks.extend(zip(np.asarray(pos)[:k].tolist(),
+                         np.asarray(val)[:k].tolist()))
+
+    # differential check vs the whole-signal ops
+    y_all = ops.causal_fir(x, h)
+    wpos, _, wcount = ops.detect_peaks_fixed(
+        y_all, ops.EXTREMUM_TYPE_MAXIMUM, capacity=n - 2)
+    want = np.asarray(wpos)[:int(wcount)].tolist()
+    assert [p for p, _ in peaks] == want, "stream != whole-signal"
+
+    # tone crests stand clear of the smoothed noise ripple; nearby
+    # maxima on one crest top collapse into a single cluster
+    strong = np.array([p for p, v in peaks if v > 0.5])
+    crests = strong[np.r_[True, np.diff(strong) > 50]]
+    rate = fs / np.median(np.diff(crests))
+    print(f"{len(peaks)} maxima, {len(crests)} tone crests; "
+          f"crest rate ~{rate:.1f} Hz (true tone: 30.0 Hz)")
+    print("stream == whole-signal: OK")
+
+
+if __name__ == "__main__":
+    main()

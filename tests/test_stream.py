@@ -1,0 +1,155 @@
+"""Streaming ops (ops/stream.py): chunked == whole-signal differential.
+
+The contract under test is the module's oracle: concatenated step
+outputs must equal the whole-signal op on the concatenated input — the
+streaming rebirth of the reference's carried overlap-save block loop
+(src/convolve.c:181-228)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+
+def _chunks(x, size):
+    return [x[..., i:i + size] for i in range(0, x.shape[-1], size)]
+
+
+@pytest.mark.parametrize("h_len", [1, 4, 31, 127])
+@pytest.mark.parametrize("chunk", [64, 100, 256])
+def test_fir_stream_matches_whole(rng, h_len, chunk):
+    n = 1024
+    x = rng.standard_normal(n, dtype=np.float32)
+    h = rng.standard_normal(h_len, dtype=np.float32)
+    want = np.asarray(ops.causal_fir(x, h))
+
+    state = ops.fir_stream_init(h)
+    outs = []
+    for c in _chunks(x, chunk):
+        state, y = ops.fir_stream_step(state, c, h)
+        outs.append(np.asarray(y))
+    got = np.concatenate(outs)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fir_stream_batched(rng):
+    x = rng.standard_normal((3, 512), dtype=np.float32)
+    h = rng.standard_normal(17, dtype=np.float32)
+    want = np.asarray(ops.causal_fir(x, h))
+    state = ops.fir_stream_init(h, batch_shape=(3,))
+    outs = []
+    for c in _chunks(x, 128):
+        state, y = ops.fir_stream_step(state, c, h)
+        outs.append(np.asarray(y))
+    np.testing.assert_array_equal(np.concatenate(outs, axis=-1), want)
+
+
+def test_minmax_stream(rng):
+    x = rng.standard_normal((2, 777), dtype=np.float32)
+    state = ops.minmax_stream_init(batch_shape=(2,))
+    for c in _chunks(x, 100):
+        state, (vmin, vmax) = ops.minmax_stream_step(state, c)
+    np.testing.assert_array_equal(np.asarray(vmin), x.min(axis=-1))
+    np.testing.assert_array_equal(np.asarray(vmax), x.max(axis=-1))
+    # the running result feeds the rescale second pass exactly as
+    # minmax feeds normalize (normalize.c:435-441), per row here
+    from veles.simd_tpu.ops.normalize import rescale_minmax
+    # stats derive from x itself -> clip=True per normalize.py:41-45
+    # (TPU reciprocal rounding can land 1 ulp outside the interval)
+    got = np.asarray(rescale_minmax(x, vmin[..., None], vmax[..., None],
+                                    clip=True))
+    assert got.min() >= -1.0 and got.max() <= 1.0
+    assert got.shape == x.shape
+
+
+def _stream_peaks(x, chunk, capacity_per_chunk=None):
+    state = ops.peaks_stream_init()
+    all_pos, all_val = [], []
+    for c in _chunks(x, chunk):
+        state, (pos, val, count) = ops.peaks_stream_step(
+            state, c, capacity=capacity_per_chunk or c.shape[-1])
+        k = int(count)
+        all_pos.extend(np.asarray(pos)[:k].tolist())
+        all_val.extend(np.asarray(val)[:k].tolist())
+    return np.array(all_pos), np.array(all_val, np.float32)
+
+
+@pytest.mark.parametrize("chunk", [64, 100, 128])
+def test_peaks_stream_matches_whole(rng, chunk):
+    n = 512
+    x = rng.standard_normal(n, dtype=np.float32)
+    pos, val, count = ops.detect_peaks_fixed(x, capacity=n - 2)
+    k = int(count)
+    want_pos = np.asarray(pos)[:k]
+    want_val = np.asarray(val)[:k]
+
+    got_pos, got_val = _stream_peaks(x, chunk)
+    np.testing.assert_array_equal(got_pos, want_pos)
+    np.testing.assert_array_equal(got_val, want_val)
+
+
+def test_peaks_stream_boundary_peak(rng):
+    """A peak exactly at a chunk boundary (last sample of chunk k) must
+    be reported once, by the step that makes it decidable."""
+    x = np.zeros(128, np.float32)
+    x[63] = 1.0     # last sample of the first 64-chunk
+    x[64] = -1.0    # first sample of the second
+    got_pos, got_val = _stream_peaks(x, 64)
+    pos, val, count = ops.detect_peaks_fixed(x, capacity=126)
+    np.testing.assert_array_equal(got_pos, np.asarray(pos)[:int(count)])
+    np.testing.assert_array_equal(got_val, np.asarray(val)[:int(count)])
+    assert 63 in got_pos.tolist() and 64 in got_pos.tolist()
+
+
+def test_peaks_stream_first_sample_not_tested():
+    """Global index 0 is never a peak (whole-signal interior starts at 1,
+    detect_peaks.c:67) even when the stream opens with a local max."""
+    x = np.r_[np.float32(5.0), np.zeros(63, np.float32)]
+    got_pos, _ = _stream_peaks(x, 32)
+    assert 0 not in got_pos.tolist()
+
+
+def test_stream_scan_fir(rng):
+    n, chunk = 1024, 128
+    x = rng.standard_normal(n, dtype=np.float32)
+    h = rng.standard_normal(15, dtype=np.float32)
+    chunks = jnp.asarray(x.reshape(n // chunk, chunk))
+    state = ops.fir_stream_init(h)
+    final, ys = ops.stream_scan(ops.fir_stream_step, state, chunks, h)
+    got = np.asarray(ys).reshape(-1)
+    np.testing.assert_array_equal(got, np.asarray(ops.causal_fir(x, h)))
+    assert final.tail.shape == (14,)
+
+
+def test_stream_scan_peaks(rng):
+    n, chunk = 512, 64
+    x = rng.standard_normal(n, dtype=np.float32)
+    chunks = jnp.asarray(x.reshape(n // chunk, chunk))
+    state = ops.peaks_stream_init()
+    _, (pos, val, count) = ops.stream_scan(
+        ops.peaks_stream_step, state, chunks, capacity=chunk)
+    got_pos = []
+    for i in range(n // chunk):
+        got_pos.extend(np.asarray(pos[i])[:int(count[i])].tolist())
+    wpos, _, wcount = ops.detect_peaks_fixed(x, capacity=n - 2)
+    np.testing.assert_array_equal(np.array(got_pos),
+                                  np.asarray(wpos)[:int(wcount)])
+
+
+def test_fir_stream_state_is_checkpointable(tmp_path, rng):
+    """Streaming state is a plain pytree — utils/checkpoint roundtrips it
+    (the resume story the reference lacks, SURVEY §5)."""
+    from veles.simd_tpu.utils import checkpoint
+
+    x = rng.standard_normal(256, dtype=np.float32)
+    h = rng.standard_normal(9, dtype=np.float32)
+    state = ops.fir_stream_init(h)
+    state, _ = ops.fir_stream_step(state, x[:128], h)
+    checkpoint.save(str(tmp_path / "st"), {"tail": state.tail})
+    restored = checkpoint.restore(str(tmp_path / "st"))
+    resumed = ops.FirStreamState(jnp.asarray(restored["tail"]))
+    _, y2 = ops.fir_stream_step(resumed, x[128:], h)
+    want = np.asarray(ops.causal_fir(x, h))[128:]
+    np.testing.assert_array_equal(np.asarray(y2), want)

@@ -34,3 +34,7 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate_fft, cross_correlate_finalize,
     cross_correlate_initialize, cross_correlate_overlap_save,
     cross_correlate_simd)
+from veles.simd_tpu.ops.stream import (  # noqa: F401
+    FirStreamState, MinMaxStreamState, PeaksStreamState, fir_stream_init,
+    fir_stream_step, minmax_stream_init, minmax_stream_step,
+    peaks_stream_init, peaks_stream_step, stream_scan)

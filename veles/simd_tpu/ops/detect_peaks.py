@@ -37,9 +37,8 @@ from veles.simd_tpu.reference.detect_peaks import (  # noqa: F401 (re-export)
 _ONEHOT_COMPACT_MAX_CAP = 128
 
 
-@functools.partial(jax.jit, static_argnames=("extremum_type", "capacity"))
-def _detect_peaks_fixed_xla(data, extremum_type, capacity):
-    data = jnp.asarray(data, jnp.float32)
+def _select_extrema(data, extremum_type):
+    """Interior-point selection mask (check_peak, detect_peaks.c:41-56)."""
     d1 = data[..., 1:-1] - data[..., :-2]
     d2 = data[..., 1:-1] - data[..., 2:]
     strict = d1 * d2 > 0
@@ -48,6 +47,14 @@ def _detect_peaks_fixed_xla(data, extremum_type, capacity):
         sel = sel | (strict & (d1 > 0))
     if extremum_type & EXTREMUM_TYPE_MINIMUM:
         sel = sel | (strict & (d1 < 0))
+    return sel
+
+
+def _compact_selected(sel, data, capacity):
+    """Left-compact the selected interior points of ``data`` into
+    ``capacity`` slots -> (positions, values, count). Shared by the
+    whole-signal op and the streaming layer (ops/stream.py), which
+    additionally masks ``sel`` at chunk boundaries."""
     n = data.shape[-1] - 2
     if capacity <= _ONEHOT_COMPACT_MAX_CAP:
         # Compaction on the MXU: each selected interior index has a unique
@@ -83,6 +90,13 @@ def _detect_peaks_fixed_xla(data, extremum_type, capacity):
     values = jnp.where(valid, values, 0).astype(jnp.float32)
     count = jnp.sum(sel, axis=-1).astype(jnp.int32)
     return positions, values, jnp.minimum(count, capacity)
+
+
+@functools.partial(jax.jit, static_argnames=("extremum_type", "capacity"))
+def _detect_peaks_fixed_xla(data, extremum_type, capacity):
+    data = jnp.asarray(data, jnp.float32)
+    return _compact_selected(_select_extrema(data, extremum_type),
+                             data, capacity)
 
 
 def detect_peaks_fixed(data, extremum_type=EXTREMUM_TYPE_BOTH, *,

@@ -1,0 +1,173 @@
+"""Streaming (chunked, stateful) signal processing.
+
+The reference's answer to signals longer than one buffer is the
+overlap-save block loop: process block i, carry M-1 samples of overlap
+into block i+1 (src/convolve.c:181-228, handle fields
+convolve_structs.h:39-74). That loop lives *inside* one call; between
+calls the reference keeps no state — a real-time caller would re-feed
+the overlap manually.
+
+Here the carry is first-class: every streaming op is an explicit
+``init -> step`` pair over an immutable state pytree,
+
+    state = fir_stream_init(h)
+    state, y = fir_stream_step(state, chunk, h)      # any number of times
+
+with the contract that the concatenated chunk outputs equal the
+whole-signal op on the concatenated input — the differential test
+oracle for this module. Functional state makes the steps jittable,
+batchable (leading axes), checkpointable (utils/checkpoint), and
+scannable: :func:`stream_scan` runs a step over a pre-chunked
+``(num_chunks, ...)`` array under ``lax.scan`` in one compiled loop.
+
+Ops:
+- ``fir_stream_*``     — causal FIR across chunks (carry: last M-1 in)
+- ``minmax_stream_*``  — running min/max (the minmax1D pass of
+                         normalize2D, src/normalize.c:435-441, over a
+                         stream; finish with normalize.rescale_minmax)
+- ``peaks_stream_*``   — detect_peaks across chunk boundaries (carry:
+                         last 2 samples + global offset), positions in
+                         global coordinates, exact vs the whole-signal op
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.ops.convolve import causal_fir
+from veles.simd_tpu.ops.detect_peaks import (
+    EXTREMUM_TYPE_BOTH, _compact_selected, _select_extrema)
+
+
+# ---------------------------------------------------------------------------
+# causal FIR
+# ---------------------------------------------------------------------------
+
+class FirStreamState(NamedTuple):
+    """Carry for streaming causal FIR: the last ``m-1`` input samples."""
+    tail: jax.Array
+
+
+def fir_stream_init(h, batch_shape=()) -> FirStreamState:
+    """Start-of-stream state (zero history = the causal_fir left pad)."""
+    m = jnp.shape(h)[-1]
+    return FirStreamState(jnp.zeros((*batch_shape, m - 1), jnp.float32))
+
+
+@jax.jit
+def fir_stream_step(state: FirStreamState, chunk, h):
+    """Filter one chunk -> (state', y), ``y.shape == chunk.shape``.
+
+    Concatenating the ``y`` of successive steps equals
+    ``causal_fir(concatenated_input, h)`` exactly (same shift-add
+    accumulation order per output sample).
+    """
+    chunk = jnp.asarray(chunk, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    m = h.shape[-1]
+    z = jnp.concatenate([state.tail, chunk], axis=-1)
+    y = causal_fir(z, h)[..., m - 1:]
+    new_tail = z[..., z.shape[-1] - (m - 1):]
+    return FirStreamState(new_tail), y
+
+
+# ---------------------------------------------------------------------------
+# running minmax
+# ---------------------------------------------------------------------------
+
+class MinMaxStreamState(NamedTuple):
+    vmin: jax.Array
+    vmax: jax.Array
+
+
+def minmax_stream_init(batch_shape=()) -> MinMaxStreamState:
+    return MinMaxStreamState(
+        jnp.full(batch_shape, jnp.inf, jnp.float32),
+        jnp.full(batch_shape, -jnp.inf, jnp.float32))
+
+
+@jax.jit
+def minmax_stream_step(state: MinMaxStreamState, chunk):
+    """Fold one chunk -> (state', (vmin, vmax)) running over the stream."""
+    chunk = jnp.asarray(chunk, jnp.float32)
+    vmin = jnp.minimum(state.vmin, jnp.min(chunk, axis=-1))
+    vmax = jnp.maximum(state.vmax, jnp.max(chunk, axis=-1))
+    new = MinMaxStreamState(vmin, vmax)
+    return new, (vmin, vmax)
+
+
+# ---------------------------------------------------------------------------
+# streaming peak detection
+# ---------------------------------------------------------------------------
+
+class PeaksStreamState(NamedTuple):
+    """Last two stream samples + the global index of carry[..., 0].
+
+    Two samples are exactly what boundary-exactness needs: the last
+    sample of chunk k is an interior point only once chunk k+1 provides
+    its right neighbor — the same reason the reference's scalar loop
+    stops at size-2 (detect_peaks.c:67)."""
+    carry: jax.Array     # (..., 2) float32
+    offset: jax.Array    # int32 scalar: global index of carry[..., 0]
+
+
+def peaks_stream_init(batch_shape=()) -> PeaksStreamState:
+    # offset -2: the two zero-filled pseudo-samples sit at global
+    # positions -2/-1, and the mask below drops any "peak" whose
+    # neighborhood touches them (global position < 1, matching the
+    # whole-signal op which never tests index 0).
+    return PeaksStreamState(
+        jnp.zeros((*batch_shape, 2), jnp.float32),
+        jnp.int32(-2))
+
+
+@functools.partial(jax.jit, static_argnames=("extremum_type", "capacity"))
+def peaks_stream_step(state: PeaksStreamState, chunk,
+                      extremum_type=EXTREMUM_TYPE_BOTH, *, capacity):
+    """Detect peaks in one chunk -> (state', (positions, values, count)).
+
+    Positions are **global** stream indices (-1 pads past ``count``).
+    The union of all steps' peaks equals ``detect_peaks_fixed`` on the
+    whole stream: each step reports the peaks whose interior test became
+    decidable with this chunk — global positions offset-2+1 .. offset+L-2
+    relative to the carry-extended block.
+    """
+    chunk = jnp.asarray(chunk, jnp.float32)
+    # a step decides exactly chunk-many interior points; clamp like
+    # detect_peaks_fixed does so both compaction branches emit the same
+    # fixed (capacity,) width
+    capacity = min(capacity, chunk.shape[-1])
+    z = jnp.concatenate(
+        [jnp.broadcast_to(state.carry, (*chunk.shape[:-1], 2)), chunk],
+        axis=-1)
+    sel = _select_extrema(z, extremum_type)
+    # interior z-index i+1 has global position offset + i + 1; drop the
+    # start-of-stream pseudo neighborhood (global position < 1)
+    n_int = z.shape[-1] - 2
+    glob = state.offset + 1 + jnp.arange(n_int)
+    sel = sel & (glob >= 1)
+    positions, values, count = _compact_selected(sel, z, capacity)
+    positions = jnp.where(positions >= 0,
+                          positions + state.offset, -1).astype(jnp.int32)
+    new = PeaksStreamState(z[..., z.shape[-1] - 2:],
+                           state.offset + jnp.int32(chunk.shape[-1]))
+    return new, (positions, values, count)
+
+
+# ---------------------------------------------------------------------------
+# scan driver
+# ---------------------------------------------------------------------------
+
+def stream_scan(step, state, chunks, *step_args, **step_kwargs):
+    """Run a streaming ``step`` over a pre-chunked leading axis in one
+    compiled loop: ``chunks`` is ``(num_chunks, ...chunk...)``; returns
+    ``(final_state, stacked_outputs)``. The `lax.scan` form of the
+    reference's sequential block loop (convolve.c:181-228) — sequential
+    by data dependence, compiled once."""
+    def body(s, c):
+        return step(s, c, *step_args, **step_kwargs)
+    return jax.lax.scan(body, state, chunks)
