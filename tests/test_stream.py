@@ -138,6 +138,67 @@ def test_stream_scan_peaks(rng):
                                   np.asarray(wpos)[:int(wcount)])
 
 
+@pytest.mark.parametrize("order,level", [(2, 1), (8, 1), (4, 2), (6, 3),
+                                         (12, 2)])
+@pytest.mark.parametrize("chunk", [128, 200])
+def test_swt_stream_matches_whole_delayed(rng, order, level, chunk):
+    """Streamed à-trous bank == whole-signal SWT delayed by D, exactly,
+    for every sample whose window never crosses the signal end (the
+    extension region a stream cannot see)."""
+    n = 1024
+    x = rng.standard_normal(n, dtype=np.float32)
+    d = ops.swt_stream_delay(order, level)
+    state = ops.swt_stream_init(order, level)
+    his, los = [], []
+    for c in _chunks(x, chunk):
+        state, (hi, lo) = ops.swt_stream_step(
+            state, c, "daubechies", order, level)
+        his.append(np.asarray(hi))
+        los.append(np.asarray(lo))
+    got_hi = np.concatenate(his)[d:]
+    got_lo = np.concatenate(los)[d:]
+    want_hi, want_lo = ops.stationary_wavelet_apply(
+        x, "daubechies", order, level=level)
+    np.testing.assert_array_equal(got_hi, np.asarray(want_hi)[:n - d])
+    np.testing.assert_array_equal(got_lo, np.asarray(want_lo)[:n - d])
+
+
+def test_swt_stream_cascade_two_levels(rng):
+    """Feeding level-1 lo into a level-2 stream reproduces the
+    whole-signal cascade with the delays summed — the shift-invariance
+    of the undecimated transform, streamed."""
+    n, chunk, order = 1024, 128, 4
+    x = rng.standard_normal(n, dtype=np.float32)
+    d1 = ops.swt_stream_delay(order, 1)
+    d2 = ops.swt_stream_delay(order, 2)
+    s1 = ops.swt_stream_init(order, 1)
+    s2 = ops.swt_stream_init(order, 2)
+    hi2s = []
+    for c in _chunks(x, chunk):
+        s1, (_, lo1) = ops.swt_stream_step(s1, c, "daubechies", order, 1)
+        s2, (hi2, _) = ops.swt_stream_step(s2, lo1, "daubechies", order, 2)
+        hi2s.append(np.asarray(hi2))
+    got = np.concatenate(hi2s)[d1 + d2:]
+
+    _, wlo1 = ops.stationary_wavelet_apply(x, "daubechies", order, level=1)
+    whi2, _ = ops.stationary_wavelet_apply(
+        np.asarray(wlo1), "daubechies", order, level=2)
+    np.testing.assert_array_equal(got, np.asarray(whi2)[:n - d1 - d2])
+
+
+def test_swt_stream_scan(rng):
+    n, chunk, order = 2048, 256, 8
+    x = rng.standard_normal(n, dtype=np.float32)
+    chunks = jnp.asarray(x.reshape(n // chunk, chunk))
+    state = ops.swt_stream_init(order)
+    _, (his, los) = ops.stream_scan(ops.swt_stream_step, state, chunks,
+                                    "daubechies", order, 1)
+    d = ops.swt_stream_delay(order)
+    want_hi, _ = ops.stationary_wavelet_apply(x, "daubechies", order)
+    np.testing.assert_array_equal(np.asarray(his).reshape(-1)[d:],
+                                  np.asarray(want_hi)[:n - d])
+
+
 def test_fir_stream_state_is_checkpointable(tmp_path, rng):
     """Streaming state is a plain pytree — utils/checkpoint roundtrips it
     (the resume story the reference lacks, SURVEY §5)."""

@@ -35,6 +35,7 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate_initialize, cross_correlate_overlap_save,
     cross_correlate_simd)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
-    FirStreamState, MinMaxStreamState, PeaksStreamState, fir_stream_init,
-    fir_stream_step, minmax_stream_init, minmax_stream_step,
-    peaks_stream_init, peaks_stream_step, stream_scan)
+    FirStreamState, MinMaxStreamState, PeaksStreamState, SwtStreamState,
+    fir_stream_init, fir_stream_step, minmax_stream_init,
+    minmax_stream_step, peaks_stream_init, peaks_stream_step, stream_scan,
+    swt_stream_delay, swt_stream_init, swt_stream_step)

@@ -28,6 +28,10 @@ Ops:
 - ``peaks_stream_*``   — detect_peaks across chunk boundaries (carry:
                          last 2 samples + global offset), positions in
                          global coordinates, exact vs the whole-signal op
+- ``swt_stream_*``     — stationary wavelet (a-trous) bank per level
+                         (carry: dilated filter reach), exact vs the
+                         whole-signal op delayed by swt_stream_delay;
+                         levels cascade by chaining lo into level+1
 """
 
 from __future__ import annotations
@@ -37,10 +41,23 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from veles.simd_tpu.ops.convolve import causal_fir
 from veles.simd_tpu.ops.detect_peaks import (
     EXTREMUM_TYPE_BOTH, _compact_selected, _select_extrema)
+from veles.simd_tpu.ops.wavelet import _swt_bank
+
+
+def _check_stream_batch(carry, chunk, init_name):
+    """Carry batch must equal chunk batch — a state initialized without
+    ``batch_shape`` cannot serve batched chunks (silent broadcasting
+    would change the carry's shape mid-stream and break lax.scan)."""
+    if carry.shape[:-1] != chunk.shape[:-1]:
+        raise ValueError(
+            f"stream state batch {carry.shape[:-1]} != chunk batch "
+            f"{chunk.shape[:-1]}; initialize with "
+            f"{init_name}(..., batch_shape={chunk.shape[:-1]})")
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +86,7 @@ def fir_stream_step(state: FirStreamState, chunk, h):
     chunk = jnp.asarray(chunk, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     m = h.shape[-1]
+    _check_stream_batch(state.tail, chunk, "fir_stream_init")
     z = jnp.concatenate([state.tail, chunk], axis=-1)
     y = causal_fir(z, h)[..., m - 1:]
     new_tail = z[..., z.shape[-1] - (m - 1):]
@@ -113,6 +131,9 @@ class PeaksStreamState(NamedTuple):
     stops at size-2 (detect_peaks.c:67)."""
     carry: jax.Array     # (..., 2) float32
     offset: jax.Array    # int32 scalar: global index of carry[..., 0]
+    # int32 positions bound the addressable stream at 2**31-1 samples
+    # (~3 days at 8 kHz); past that, re-init and track an epoch host-side
+    # (the whole-signal op has the same int32 position dtype).
 
 
 def peaks_stream_init(batch_shape=()) -> PeaksStreamState:
@@ -141,9 +162,8 @@ def peaks_stream_step(state: PeaksStreamState, chunk,
     # detect_peaks_fixed does so both compaction branches emit the same
     # fixed (capacity,) width
     capacity = min(capacity, chunk.shape[-1])
-    z = jnp.concatenate(
-        [jnp.broadcast_to(state.carry, (*chunk.shape[:-1], 2)), chunk],
-        axis=-1)
+    _check_stream_batch(state.carry, chunk, "peaks_stream_init")
+    z = jnp.concatenate([state.carry, chunk], axis=-1)
     sel = _select_extrema(z, extremum_type)
     # interior z-index i+1 has global position offset + i + 1; drop the
     # start-of-stream pseudo neighborhood (global position < 1)
@@ -156,6 +176,66 @@ def peaks_stream_step(state: PeaksStreamState, chunk,
     new = PeaksStreamState(z[..., z.shape[-1] - 2:],
                            state.offset + jnp.int32(chunk.shape[-1]))
     return new, (positions, values, count)
+
+
+# ---------------------------------------------------------------------------
+# streaming stationary wavelet (à-trous) bank
+# ---------------------------------------------------------------------------
+
+class SwtStreamState(NamedTuple):
+    """Carry for one streaming SWT level: the last ``D`` input samples,
+    ``D = (order-1) * 2**(level-1)`` (the dilated filter's reach)."""
+    tail: jax.Array
+
+
+def swt_stream_delay(order: int, level: int = 1) -> int:
+    """Samples of latency one streaming SWT level introduces."""
+    if level < 1:
+        raise ValueError("level must be >= 1")  # match wavelet.py:195
+    return (order - 1) * (1 << (level - 1))
+
+
+def swt_stream_init(order, level=1, batch_shape=()) -> SwtStreamState:
+    """Start-of-stream state (zero prehistory). The first
+    :func:`swt_stream_delay` samples of the concatenated output are
+    warm-up (they reach into the zero prehistory); past them the stream
+    equals the whole-signal op delayed by that amount."""
+    d = swt_stream_delay(order, level)
+    return SwtStreamState(jnp.zeros((*batch_shape, d), jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("wavelet_type", "order",
+                                             "level"))
+def swt_stream_step(state: SwtStreamState, chunk,
+                    wavelet_type="daubechies", order=8, level=1):
+    """One chunk through the dilated dual filter bank -> (state',
+    (hi, lo)), each output chunk-shaped.
+
+    The whole-signal op is forward-looking (out[t] reads
+    src[t .. t+D], _swt_bank in ops/wavelet.py); a stream can only look
+    back, so outputs lag by ``D = swt_stream_delay(order, level)``:
+    dropping the first D concatenated samples reproduces
+    ``stationary_wavelet_apply(x, ...)[: n-D]`` exactly, any extension
+    mode (the extension only shapes the final D outputs, which need
+    post-end samples a stream never sees).
+
+    Because the à-trous transform never decimates, it is shift-invariant
+    for arbitrary shifts — cascading levels by feeding this step's ``lo``
+    into a ``level+1`` stream reproduces the whole-signal cascade with
+    the levels' delays summed (tested in tests/test_stream.py).
+    """
+    from veles.simd_tpu import wavelet_data
+
+    chunk = jnp.asarray(chunk, jnp.float32)
+    hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order)
+    filters = jnp.asarray(np.stack([hi, lo]))
+    stride = 1 << (level - 1)
+    _check_stream_batch(state.tail, chunk, "swt_stream_init")
+    z = jnp.concatenate([state.tail, chunk], axis=-1)
+    out_hi, out_lo = _swt_bank(z, filters, stride, chunk.shape[-1])
+    d = state.tail.shape[-1]
+    new_tail = z[..., z.shape[-1] - d:]
+    return SwtStreamState(new_tail), (out_hi, out_lo)
 
 
 # ---------------------------------------------------------------------------
