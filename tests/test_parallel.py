@@ -184,3 +184,51 @@ class TestShardedDecompose:
         with pytest.raises(ValueError, match=">= 1"):
             parallel.stationary_wavelet_decompose_sharded(
                 np.zeros(128, np.float32), 0, mesh=mesh)
+
+
+class TestStreamSharded:
+    """Streaming steps (ops/stream.py) under batch sharding: states and
+    chunks sharded over a data axis stay device-resident across steps —
+    the serving topology (many independent streams, one per shard group)
+    with no collectives needed."""
+
+    def test_fir_swt_peaks_batch_sharded(self, rng):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from veles.simd_tpu import ops, parallel
+
+        mesh = parallel.make_mesh({"data": 8})
+        shard = NamedSharding(mesh, P("data", None))
+        batch, chunk, n_chunks = 16, 256, 4
+        x = rng.normal(size=(batch, chunk * n_chunks)).astype(np.float32)
+        h = rng.normal(size=17).astype(np.float32)
+
+        fir = jax.device_put(ops.fir_stream_init(h, batch_shape=(batch,)),
+                             NamedSharding(mesh, P("data", None)))
+        swt = jax.device_put(ops.swt_stream_init(8, 1, batch_shape=(batch,)),
+                             NamedSharding(mesh, P("data", None)))
+        pk_ref = ops.peaks_stream_init(batch_shape=(batch,))
+        pk = type(pk_ref)(jax.device_put(pk_ref.carry, shard), pk_ref.offset)
+
+        outs, peak_counts = [], []
+        for i in range(n_chunks):
+            c = jax.device_put(
+                jnp.asarray(x[:, i * chunk:(i + 1) * chunk]), shard)
+            fir, y = ops.fir_stream_step(fir, c, h)
+            swt, (hi, lo) = ops.swt_stream_step(swt, y, "daubechies", 8, 1)
+            pk, (pos, val, cnt) = ops.peaks_stream_step(pk, y, capacity=chunk)
+            outs.append(np.asarray(hi))
+            peak_counts.append(np.asarray(cnt))
+            # states stay sharded over the data axis step to step
+            assert fir.tail.sharding.is_equivalent_to(shard, fir.tail.ndim)
+
+        # differential vs the unsharded whole-signal path
+        y_all = ops.causal_fir(x, h)
+        want_hi, _ = ops.stationary_wavelet_apply(y_all, "daubechies", 8)
+        d = ops.swt_stream_delay(8, 1)
+        got_hi = np.concatenate(outs, axis=-1)[:, d:]
+        np.testing.assert_array_equal(got_hi,
+                                      np.asarray(want_hi)[:, :x.shape[-1] - d])
+        _, _, wcnt = ops.detect_peaks_fixed(y_all, capacity=x.shape[-1] - 2)
+        assert int(np.sum(np.stack(peak_counts))) == int(np.sum(wcnt))
