@@ -5,7 +5,6 @@ outputs must equal the whole-signal op on the concatenated input — the
 streaming rebirth of the reference's carried overlap-save block loop
 (src/convolve.c:181-228)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
