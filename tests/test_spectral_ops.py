@@ -1,0 +1,161 @@
+"""Spectral ops (ops/spectral.py): framing, STFT/ISTFT, spectrogram.
+
+Oracles: a plain NumPy loop implementation (the float64 `_na` pattern,
+SURVEY §4) and the exact weighted-average reconstruction identity."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+
+def np_frame(x, L, hop):
+    n_frames = 1 + (x.shape[-1] - L) // hop
+    return np.stack([x[..., s * hop:s * hop + L]
+                     for s in range(n_frames)], axis=-2)
+
+
+@pytest.mark.parametrize("L,hop", [(256, 64), (256, 128), (256, 256),
+                                   (100, 30), (64, 17)])
+def test_frame_matches_numpy(rng, L, hop):
+    x = rng.standard_normal(1024, dtype=np.float32)
+    got = np.asarray(ops.frame(x, L, hop))
+    np.testing.assert_array_equal(got, np_frame(x, L, hop))
+
+
+def test_frame_batched(rng):
+    x = rng.standard_normal((3, 512), dtype=np.float32)
+    got = np.asarray(ops.frame(x, 128, 32))
+    np.testing.assert_array_equal(got, np_frame(x, 128, 32))
+
+
+def test_frame_validation(rng):
+    with pytest.raises(ValueError, match="frame_length"):
+        ops.frame(np.zeros(16, np.float32), 32, 8)
+    with pytest.raises(ValueError, match="hop"):
+        ops.frame(np.zeros(64, np.float32), 32, 0)
+
+
+@pytest.mark.parametrize("hop", [32, 64, 128])
+def test_overlap_add_matches_numpy(rng, hop):
+    L, F = 128, 9
+    frames = rng.standard_normal((F, L), dtype=np.float32)
+    want = np.zeros((F - 1) * hop + L, np.float32)
+    for f in range(F):
+        want[f * hop:f * hop + L] += frames[f]
+    got = np.asarray(ops.overlap_add(jnp.asarray(frames), hop))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_overlap_add_validation(rng):
+    with pytest.raises(ValueError, match="frame_length % hop"):
+        ops.overlap_add(jnp.zeros((4, 100)), 33)
+
+
+def test_frame_overlap_add_roundtrip_rect(rng):
+    """With a rectangular window and hop == L the pair is a reshape."""
+    x = rng.standard_normal(512, dtype=np.float32)
+    f = ops.frame(x, 64, 64)
+    np.testing.assert_array_equal(np.asarray(ops.overlap_add(f, 64)), x)
+
+
+def np_stft(x, nfft, hop, window):
+    return np.fft.rfft(np_frame(x, nfft, hop) * window, axis=-1)
+
+
+@pytest.mark.parametrize("nfft,hop", [(256, 64), (256, 128), (128, 32)])
+def test_stft_matches_numpy(rng, nfft, hop):
+    x = rng.standard_normal(2048, dtype=np.float32)
+    w = np.asarray(ops.hann_window(nfft))
+    got = np.asarray(ops.stft(x, nfft=nfft, hop=hop))
+    want = np_stft(x, nfft, hop, w)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("nfft,hop", [(256, 64), (256, 128), (128, 32),
+                                      (64, 16)])
+def test_istft_reconstructs(rng, nfft, hop):
+    """Weighted-average reconstruction is exact wherever squared-window
+    coverage is nonzero — here everywhere except the first/last hop
+    (periodic Hann has w[0] = 0)."""
+    n = 2048
+    x = rng.standard_normal(n, dtype=np.float32)
+    s = ops.stft(x, nfft=nfft, hop=hop)
+    y = np.asarray(ops.istft(s, nfft=nfft, hop=hop))
+    covered = slice(hop, (s.shape[-2] - 1) * hop + nfft - hop)
+    np.testing.assert_allclose(y[covered], x[covered], atol=2e-4)
+
+
+def test_istft_length_trim_and_pad(rng):
+    x = rng.standard_normal(1024, dtype=np.float32)
+    s = ops.stft(x, nfft=128, hop=32)
+    y = ops.istft(s, nfft=128, hop=32, length=1024)
+    assert y.shape == (1024,)
+    # a signal whose tail isn't framed: length > OLA output zero-pads
+    # (the zero-coverage convention) instead of silently under-returning
+    x2 = rng.standard_normal(1000, dtype=np.float32)
+    s2 = ops.stft(x2, nfft=128, hop=32)
+    y2 = np.asarray(ops.istft(s2, nfft=128, hop=32, length=1000))
+    assert y2.shape == (1000,)
+    assert np.all(y2[992:] == 0)
+
+
+def test_istft_batched(rng):
+    x = rng.standard_normal((4, 1024), dtype=np.float32)
+    s = ops.stft(x, nfft=128, hop=32)
+    y = np.asarray(ops.istft(s, nfft=128, hop=32))
+    for b in range(4):
+        yb = np.asarray(ops.istft(ops.stft(x[b], nfft=128, hop=32),
+                                  nfft=128, hop=32))
+        np.testing.assert_allclose(y[b], yb, atol=1e-6)
+
+
+def test_custom_window_roundtrip(rng):
+    """Any window works — no COLA condition (the normalization divides
+    by the actual squared-window overlap)."""
+    nfft, hop = 128, 32
+    w = 0.5 + rng.random(nfft).astype(np.float32)  # strictly positive
+    x = rng.standard_normal(1024, dtype=np.float32)
+    s = ops.stft(x, nfft=nfft, hop=hop, window=w)
+    y = np.asarray(ops.istft(s, nfft=nfft, hop=hop, window=w))
+    full = (s.shape[-2] - 1) * hop + nfft
+    # positive window -> full coverage, exact everywhere framed
+    np.testing.assert_allclose(y, x[:full], atol=3e-4)
+
+
+def test_window_length_validated():
+    with pytest.raises(ValueError, match="window length"):
+        ops.stft(np.zeros(512, np.float32), nfft=128, window=np.ones(64))
+    with pytest.raises(ValueError, match="window length"):
+        ops.istft(jnp.zeros((4, 65), jnp.complex64), nfft=128,
+                  window=np.ones(64))
+
+
+def test_spectrogram_parseval(rng):
+    """Sum of the one-sided power spectrum equals frame energy (Parseval
+    with the rfft symmetry factor)."""
+    nfft, hop = 128, 128
+    x = rng.standard_normal(1024, dtype=np.float32)
+    w = np.ones(nfft, np.float32)
+    p = np.asarray(ops.spectrogram(x, nfft=nfft, hop=hop, window=w))
+    frames = np_frame(x, nfft, hop)
+    sym = np.ones(nfft // 2 + 1)
+    sym[1:-1] = 2.0
+    np.testing.assert_allclose((p * sym).sum(-1) / nfft,
+                               (frames ** 2).sum(-1), rtol=1e-4)
+
+
+def test_model_still_agrees_after_refactor(rng):
+    """SpectralPeakAnalyzer now frames through ops.frame — its golden
+    behavior must be unchanged (tone recovery at both hop kinds)."""
+    from veles.simd_tpu.models import SpectralPeakAnalyzer
+
+    t = np.arange(4096, dtype=np.float32)
+    x = np.sin(2 * np.pi * 50.0 / 512.0 * t).astype(np.float32)
+    for hop in (256, 255):
+        spa = SpectralPeakAnalyzer(nfft=512, hop=hop, capacity=1)
+        _, freq_bins, _, count = spa(x)
+        assert int(count) >= 1
+        np.testing.assert_allclose(np.asarray(freq_bins)[0], 50.0,
+                                   atol=0.2)

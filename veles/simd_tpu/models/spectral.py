@@ -24,29 +24,12 @@ from veles.simd_tpu import ops
 
 @functools.partial(jax.jit, static_argnames=("nfft", "hop", "capacity"))
 def _analyze(signals, window, nfft, hop, capacity):
+    from veles.simd_tpu.ops import spectral
+
     x = jnp.asarray(signals, jnp.float32)
-    n = x.shape[-1]
-    n_frames = 1 + (n - nfft) // hop
-    if nfft % hop == 0:
-        # gather-free overlapped framing in O(nfft/hop) ops (BASELINE.md
-        # layout rule 2, the convolve.py body/halo idiom): cut the signal
-        # into hop-sized blocks once, then each frame is nfft/hop
-        # consecutive blocks — k shifted views of the block matrix,
-        # concatenated on the last axis.
-        k = nfft // hop
-        n_blocks = n // hop
-        blocks = x[..., :n_blocks * hop].reshape(*x.shape[:-1],
-                                                 n_blocks, hop)
-        frames = jnp.concatenate(
-            [blocks[..., j:j + n_frames, :] for j in range(k)],
-            axis=-1)                             # (..., F, nfft)
-    else:
-        # irregular hop: per-frame slices (O(n_frames) traced ops — fine
-        # for short signals, avoid for long ones)
-        frames = jnp.stack([
-            jax.lax.dynamic_slice_in_dim(x, int(s), nfft, axis=-1)
-            for s in np.arange(n_frames) * hop], axis=-2)
-    spec = jnp.fft.rfft(frames * window, axis=-1)
+    # shared short-time analysis (gather-free framing for regular hop,
+    # per-frame slices otherwise) — ops/spectral.py
+    spec = spectral.stft(x, nfft=nfft, hop=hop, window=window)
     power = jnp.mean(jnp.abs(spec) ** 2, axis=-2)  # Welch average
     power = power / (jnp.sum(window ** 2) * nfft)
 

@@ -1,0 +1,145 @@
+"""Short-time spectral ops: framing, STFT, inverse STFT, spectrogram.
+
+Framework extension (the reference computes spectra only inside its FFT
+convolution, src/convolve.c:231-326; it has no analysis surface). These
+are the whole-signal building blocks under models.SpectralPeakAnalyzer,
+exposed as ops so users can build their own time-frequency processing.
+
+TPU formulation notes (BASELINE.md layout rules):
+- Overlapped framing is gather-free when ``frame_length % hop == 0``:
+  cut the signal into hop-sized blocks once, then every frame is k
+  consecutive blocks — k shifted views concatenated, O(k) ops total.
+- Inverse overlap-add is the same trick run backwards: each frame's k
+  hop-slices land at k consecutive block rows; pad-and-add the k
+  diagonals, never scatter.
+- Reconstruction uses the weighted-average identity: with the same
+  analysis and synthesis window, ``OLA(w * frames) / OLA(w^2)``
+  reproduces the signal exactly wherever the window coverage is
+  nonzero — no COLA condition on (window, hop) required.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hann_window(nfft: int, dtype=jnp.float32):
+    """Periodic Hann window (the DFT-even analysis choice)."""
+    n = jnp.arange(nfft, dtype=dtype)
+    return 0.5 - 0.5 * jnp.cos(2 * jnp.pi * n / nfft)
+
+
+@functools.partial(jax.jit, static_argnames=("frame_length", "hop"))
+def frame(x, frame_length: int, hop: int):
+    """Overlapped frames of the last axis -> (..., n_frames, frame_length),
+    ``n_frames = 1 + (n - frame_length) // hop`` (no padding: only frames
+    fully inside the signal, the models/spectral.py framing contract)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(f"frame_length {frame_length} > signal {n}")
+    if hop < 1:
+        raise ValueError("hop must be >= 1")
+    n_frames = 1 + (n - frame_length) // hop
+    if frame_length % hop == 0:
+        k = frame_length // hop
+        n_blocks = n // hop
+        blocks = x[..., :n_blocks * hop].reshape(*x.shape[:-1],
+                                                 n_blocks, hop)
+        return jnp.concatenate(
+            [blocks[..., j:j + n_frames, :] for j in range(k)], axis=-1)
+    return jnp.stack([
+        jax.lax.dynamic_slice_in_dim(x, int(s), frame_length, axis=-1)
+        for s in np.arange(n_frames) * hop], axis=-2)
+
+
+@functools.partial(jax.jit, static_argnames=("hop",))
+def overlap_add(frames, hop: int):
+    """Inverse of :func:`frame`: sum (..., F, L) frames at ``hop`` spacing
+    -> (..., (F-1)*hop + L). Requires ``L % hop == 0`` (the gather-free
+    diagonal formulation; scatter has no efficient TPU lowering)."""
+    L = frames.shape[-1]
+    F = frames.shape[-2]
+    if L % hop:
+        raise ValueError(f"overlap_add needs frame_length % hop == 0, "
+                         f"got {L} % {hop}")
+    k = L // hop
+    lead = frames.shape[:-2]
+    slices = frames.reshape(*lead, F, k, hop)
+    acc = jnp.zeros((*lead, F + k - 1, hop), frames.dtype)
+    pad0 = [(0, 0)] * len(lead)
+    for j in range(k):
+        acc = acc + jnp.pad(slices[..., :, j, :],
+                            pad0 + [(j, k - 1 - j), (0, 0)])
+    return acc.reshape(*lead, (F + k - 1) * hop)
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop"))
+def _stft(x, window, nfft, hop):
+    frames = frame(jnp.asarray(x, jnp.float32), nfft, hop)
+    return jnp.fft.rfft(frames * window, axis=-1)
+
+
+def stft(x, *, nfft: int = 512, hop: int | None = None, window=None):
+    """Short-time Fourier transform -> complex (..., n_frames, nfft//2+1).
+
+    Frames start at multiples of ``hop`` (default ``nfft // 4``); only
+    frames fully inside the signal are taken (no centering/padding).
+    ``window`` defaults to the periodic Hann.
+    """
+    hop = nfft // 4 if hop is None else hop
+    window = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    if window.shape[-1] != nfft:
+        raise ValueError(f"window length {window.shape[-1]} != nfft {nfft}")
+    return _stft(x, window, nfft, hop)
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop", "length"))
+def _istft(spec, window, nfft, hop, length):
+    frames = jnp.fft.irfft(spec, n=nfft, axis=-1) * window
+    num = overlap_add(frames, hop)
+    n_frames = spec.shape[-2]
+    wsq = jnp.broadcast_to(window * window, (n_frames, nfft))
+    den = overlap_add(wsq, hop)
+    eps = jnp.float32(1e-12)
+    y = num / jnp.maximum(den, eps) * (den > eps)
+    if length is not None:
+        produced = y.shape[-1]
+        if length > produced:
+            # beyond the framed span there is zero window coverage —
+            # extend with the same zero-coverage convention
+            y = jnp.pad(y, [(0, 0)] * (y.ndim - 1) + [(0, length - produced)])
+        else:
+            y = y[..., :length]
+    return y
+
+
+def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
+          length: int | None = None):
+    """Inverse STFT by normalized overlap-add -> (..., (F-1)*hop + nfft)
+    (trimmed to ``length`` if given).
+
+    With the same ``window``/``hop`` as :func:`stft`, reconstruction is
+    exact wherever the squared-window coverage is nonzero: OLA of
+    ``w * (w * x_frame)`` divided by OLA of ``w^2`` is a weighted average
+    of redundant views of x. Samples with zero coverage (e.g. the first
+    hop under a zero-endpoint window) come back 0. Requires
+    ``nfft % hop == 0``.
+    """
+    hop = nfft // 4 if hop is None else hop
+    window = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    if window.shape[-1] != nfft:
+        raise ValueError(f"window length {window.shape[-1]} != nfft {nfft}")
+    return _istft(spec, window, nfft, hop, length)
+
+
+def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None):
+    """Power spectrogram |STFT|^2 -> float32 (..., n_frames, nfft//2+1)."""
+    s = stft(x, nfft=nfft, hop=hop, window=window)
+    return (jnp.abs(s) ** 2).astype(jnp.float32)
