@@ -159,3 +159,24 @@ def test_model_still_agrees_after_refactor(rng):
         assert int(count) >= 1
         np.testing.assert_allclose(np.asarray(freq_bins)[0], 50.0,
                                    atol=0.2)
+
+
+def test_welch_white_noise_flat(rng):
+    """Welch PSD of unit white noise is flat at ~1/nfft per bin under
+    this normalization (E|rfft(w*x)_k|^2 = sigma^2 * sum(w^2) for
+    interior bins, divided by sum(w^2)*nfft; no one-sided doubling)."""
+    x = rng.standard_normal((8, 16384), dtype=np.float32)
+    p = np.asarray(ops.welch(x, nfft=256, hop=128)).mean(axis=0)
+    interior = p[1:-1]
+    np.testing.assert_allclose(interior.mean(), 1.0 / 256, rtol=0.1)
+    assert interior.max() / interior.min() < 3.0  # no rogue bins
+
+
+def test_welch_matches_model_normalization(rng):
+    """The op reproduces the estimator SpectralPeakAnalyzer consumes:
+    a unit-amplitude tone at an exact bin concentrates its (one-sided)
+    power there."""
+    t = np.arange(8192, dtype=np.float32)
+    tone = np.sin(2 * np.pi * 32.0 / 256.0 * t).astype(np.float32)
+    p = np.asarray(ops.welch(tone, nfft=256, hop=64))
+    assert int(p.argmax()) == 32

@@ -68,6 +68,16 @@ def public_members(mod):
     explicit = getattr(mod, "__all__", None) is not None
     for name in names:
         obj = getattr(mod, name, None)
+        # jax.jit / functools.partial(jax.jit, ...) module-level wrappers
+        # are public functions too — unwrap for the defined-here check
+        # (they fail inspect.isfunction, which hid e.g. ops.frame)
+        wrapped = getattr(obj, "__wrapped__", None)
+        if wrapped is not None and callable(obj) and \
+                (inspect.isfunction(wrapped) or inspect.isclass(wrapped)):
+            if explicit or getattr(wrapped, "__module__", None) == \
+                    mod.__name__:
+                yield name, obj
+            continue
         if inspect.isfunction(obj) or inspect.isclass(obj):
             # __all__-listed re-exports are public API; otherwise only
             # objects defined in this module.
@@ -85,6 +95,9 @@ def public_members(mod):
 
 def render_member(name, obj):
     out = []
+    wrapped = getattr(obj, "__wrapped__", None)
+    if wrapped is not None and inspect.isfunction(wrapped):
+        obj = wrapped  # render jit wrappers as the function they wrap
     if inspect.isfunction(obj):
         try:
             sig = str(inspect.signature(obj))

@@ -24,14 +24,10 @@ from veles.simd_tpu import ops
 
 @functools.partial(jax.jit, static_argnames=("nfft", "hop", "capacity"))
 def _analyze(signals, window, nfft, hop, capacity):
-    from veles.simd_tpu.ops import spectral
-
     x = jnp.asarray(signals, jnp.float32)
-    # shared short-time analysis (gather-free framing for regular hop,
-    # per-frame slices otherwise) — ops/spectral.py
-    spec = spectral.stft(x, nfft=nfft, hop=hop, window=window)
-    power = jnp.mean(jnp.abs(spec) ** 2, axis=-2)  # Welch average
-    power = power / (jnp.sum(window ** 2) * nfft)
+    # shared short-time analysis (ops/spectral.py): Welch-averaged
+    # normalized power through the gather-free framing path
+    power = ops.welch(x, nfft=nfft, hop=hop, window=window)
 
     logp = jnp.log(power + jnp.float32(1e-20))
     positions, values, count = ops.detect_peaks_topk(

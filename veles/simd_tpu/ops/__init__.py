@@ -35,7 +35,7 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate_initialize, cross_correlate_overlap_save,
     cross_correlate_simd)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
-    frame, hann_window, istft, overlap_add, spectrogram, stft)
+    frame, hann_window, istft, overlap_add, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, MinMaxStreamState, PeaksStreamState, SwtStreamState,
     fir_stream_init, fir_stream_step, minmax_stream_init,

@@ -64,6 +64,8 @@ def overlap_add(frames, hop: int):
     diagonal formulation; scatter has no efficient TPU lowering)."""
     L = frames.shape[-1]
     F = frames.shape[-2]
+    if hop < 1:
+        raise ValueError("hop must be >= 1")
     if L % hop:
         raise ValueError(f"overlap_add needs frame_length % hop == 0, "
                          f"got {L} % {hop}")
@@ -143,3 +145,16 @@ def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None):
     """Power spectrogram |STFT|^2 -> float32 (..., n_frames, nfft//2+1)."""
     s = stft(x, nfft=nfft, hop=hop, window=window)
     return (jnp.abs(s) ** 2).astype(jnp.float32)
+
+
+def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
+    """Welch power spectral density -> float32 (..., nfft//2+1): the
+    spectrogram averaged over frames, normalized by the window energy
+    (``sum(w^2) * nfft``) — the estimator models.SpectralPeakAnalyzer
+    feeds its peak extraction."""
+    hop = nfft // 4 if hop is None else hop
+    w = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    p = spectrogram(x, nfft=nfft, hop=hop, window=w)
+    return (jnp.mean(p, axis=-2) /
+            (jnp.sum(w * w) * nfft)).astype(jnp.float32)
