@@ -64,6 +64,7 @@ def np_stft(x, nfft, hop, window):
     return np.fft.rfft(np_frame(x, nfft, hop) * window, axis=-1)
 
 
+@pytest.mark.native_complex  # fetches the complex spectrum to host
 @pytest.mark.parametrize("nfft,hop", [(256, 64), (256, 128), (128, 32)])
 def test_stft_matches_numpy(rng, nfft, hop):
     x = rng.standard_normal(2048, dtype=np.float32)
@@ -71,6 +72,17 @@ def test_stft_matches_numpy(rng, nfft, hop):
     got = np.asarray(ops.stft(x, nfft=nfft, hop=hop))
     want = np_stft(x, nfft, hop, w)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_stft_magnitude_matches_numpy(rng):
+    """Host-transfer-safe twin of the differential above (|.|^2 is real):
+    runs on backends without native complex64 transfer."""
+    nfft, hop = 256, 64
+    x = rng.standard_normal(2048, dtype=np.float32)
+    w = np.asarray(ops.hann_window(nfft))
+    got = np.asarray(ops.spectrogram(x, nfft=nfft, hop=hop))
+    want = np.abs(np_stft(x, nfft, hop, w)) ** 2
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
 @pytest.mark.parametrize("nfft,hop", [(256, 64), (256, 128), (128, 32),

@@ -213,3 +213,47 @@ def test_fir_stream_state_is_checkpointable(tmp_path, rng):
     _, y2 = ops.fir_stream_step(resumed, x[128:], h)
     want = np.asarray(ops.causal_fir(x, h))[128:]
     np.testing.assert_array_equal(np.asarray(y2), want)
+
+
+@pytest.mark.native_complex  # fetches complex spectra to host
+@pytest.mark.parametrize("nfft,hop,chunk", [(256, 64, 256), (256, 128, 512),
+                                            (128, 32, 96), (64, 64, 128)])
+def test_stft_stream_matches_whole(rng, nfft, hop, chunk):
+    """Concatenated streamed frames (past warm-up) == ops.stft exactly."""
+    n = 2048
+    x = rng.standard_normal(n, dtype=np.float32)
+    warm = ops.stft_stream_warmup(nfft, hop)
+    state = ops.stft_stream_init(nfft, hop)
+    specs = []
+    for c in _chunks(x, chunk):
+        state, s = ops.stft_stream_step(state, c, nfft=nfft, hop=hop)
+        specs.append(np.asarray(s))
+    got = np.concatenate(specs, axis=-2)[warm:]
+    want = np.asarray(ops.stft(x, nfft=nfft, hop=hop))
+    np.testing.assert_array_equal(got, want[:got.shape[-2]])
+    assert got.shape == want.shape  # frame budgets agree exactly
+
+
+def test_stft_stream_magnitude(rng):
+    """Host-transfer-safe twin (per-frame power is real) + batch."""
+    nfft, hop, chunk = 128, 32, 256
+    x = rng.standard_normal((3, 1024), dtype=np.float32)
+    warm = ops.stft_stream_warmup(nfft, hop)
+    state = ops.stft_stream_init(nfft, hop, batch_shape=(3,))
+    mags = []
+    for c in _chunks(x, chunk):
+        state, s = ops.stft_stream_step(state, c, nfft=nfft, hop=hop)
+        mags.append(np.asarray(jnp.abs(s) ** 2))
+    got = np.concatenate(mags, axis=-2)[:, warm:]
+    want = np.asarray(ops.spectrogram(x, nfft=nfft, hop=hop))
+    np.testing.assert_allclose(got, want[:, :got.shape[-2]],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stft_stream_validation():
+    with pytest.raises(ValueError, match="nfft % hop"):
+        ops.stft_stream_init(100, 33)
+    st = ops.stft_stream_init(128, 32)
+    with pytest.raises(ValueError, match="multiple"):
+        ops.stft_stream_step(st, np.zeros(100, np.float32), nfft=128,
+                             hop=32)

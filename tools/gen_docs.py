@@ -61,6 +61,20 @@ MODULES = [
 ]
 
 
+def _unwrap(obj):
+    """The function/class behind a module-level jax.jit wrapper, if any."""
+    w = getattr(obj, "__wrapped__", None)
+    if w is not None and callable(obj) and \
+            (inspect.isfunction(w) or inspect.isclass(w)):
+        return w
+    return None
+
+
+def _is_callable_member(obj):
+    return (inspect.isfunction(obj) or inspect.isclass(obj)
+            or _unwrap(obj) is not None)
+
+
 def public_members(mod):
     names = getattr(mod, "__all__", None)
     if names is None:
@@ -71,9 +85,8 @@ def public_members(mod):
         # jax.jit / functools.partial(jax.jit, ...) module-level wrappers
         # are public functions too — unwrap for the defined-here check
         # (they fail inspect.isfunction, which hid e.g. ops.frame)
-        wrapped = getattr(obj, "__wrapped__", None)
-        if wrapped is not None and callable(obj) and \
-                (inspect.isfunction(wrapped) or inspect.isclass(wrapped)):
+        wrapped = _unwrap(obj)
+        if wrapped is not None:
             if explicit or getattr(wrapped, "__module__", None) == \
                     mod.__name__:
                 yield name, obj
@@ -95,9 +108,9 @@ def public_members(mod):
 
 def render_member(name, obj):
     out = []
-    wrapped = getattr(obj, "__wrapped__", None)
-    if wrapped is not None and inspect.isfunction(wrapped):
-        obj = wrapped  # render jit wrappers as the function they wrap
+    wrapped = _unwrap(obj)
+    if wrapped is not None:
+        obj = wrapped  # render jit wrappers as what they wrap
     if inspect.isfunction(obj):
         try:
             sig = str(inspect.signature(obj))
@@ -172,8 +185,7 @@ def main():
         if moddoc:
             parts.append(moddoc + "\n")
         members = list(public_members(mod))
-        funcs = [(n, o) for n, o in members
-                 if inspect.isfunction(o) or inspect.isclass(o)]
+        funcs = [(n, o) for n, o in members if _is_callable_member(o)]
         consts = [(n, o) for n, o in members if (n, o) not in funcs]
         for name, obj in funcs + consts:
             parts.append(render_member(name, obj))

@@ -24,13 +24,20 @@ class SignalPipeline:
     signal (B, N) float32; fir (M,) taps; weights (3N, K). Pure function
     of its inputs — parameters are passed per call so the same instance
     jits once per shape set.
+
+    ``precision`` pins the head contraction (e.g.
+    ``jax.lax.Precision.HIGHEST`` for f32 accumulation when training —
+    the TPU default runs the MXU in bf16, whose rounding dominates
+    finite-difference gradient checks; throughput serving keeps the
+    default).
     """
 
     def __init__(self, wavelet_type: str = "daubechies", order: int = 4,
-                 ext: str = "periodic"):
+                 ext: str = "periodic", precision=None):
         self.wavelet_type = wavelet_type
         self.order = int(order)
         self.ext = ext
+        self.precision = precision
 
     def __call__(self, signal, fir, weights):
         x = ops.normalize1D(signal, impl="xla")
@@ -42,4 +49,9 @@ class SignalPipeline:
         bhi, blo = ops.stationary_wavelet_apply(
             y, self.wavelet_type, self.order, 1, self.ext, impl="xla")
         feats = jnp.concatenate([y, bhi, blo], axis=-1)   # (B, 3N)
-        return ops.matrix_multiply(feats, weights)        # MXU head
+        # xla impl whenever precision is pinned: the pallas matmul kernel
+        # rejects precision control (ops/matrix.py), and the surrounding
+        # stages already pin xla
+        impl = "xla" if self.precision is not None else None
+        return ops.matrix_multiply(feats, weights,        # MXU head
+                                   precision=self.precision, impl=impl)

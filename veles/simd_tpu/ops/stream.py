@@ -239,6 +239,67 @@ def swt_stream_step(state: SwtStreamState, chunk,
 
 
 # ---------------------------------------------------------------------------
+# streaming STFT
+# ---------------------------------------------------------------------------
+
+class StftStreamState(NamedTuple):
+    """Carry for streaming STFT: the last ``nfft - hop`` input samples
+    (the part of the next frame this chunk has already seen)."""
+    carry: jax.Array
+
+
+def stft_stream_warmup(nfft: int, hop: int) -> int:
+    """Frames of warm-up before the stream aligns with the whole-signal
+    ``ops.stft``: the first ``nfft//hop - 1`` emitted frames window into
+    the zero prehistory."""
+    if hop < 1 or nfft % hop:
+        raise ValueError("stft streaming needs nfft % hop == 0, hop >= 1")
+    return nfft // hop - 1
+
+
+def stft_stream_init(nfft: int, hop: int | None = None,
+                     batch_shape=()) -> StftStreamState:
+    """Start-of-stream state (zero prehistory): a ``nfft - hop`` carry.
+    Validates the (nfft, hop) pair; the first
+    :func:`stft_stream_warmup` emitted frames window into the zero
+    prehistory, after which the stream equals ``ops.stft``."""
+    hop = nfft // 4 if hop is None else hop
+    stft_stream_warmup(nfft, hop)  # validates the pair
+    return StftStreamState(
+        jnp.zeros((*batch_shape, nfft - hop), jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop"))
+def stft_stream_step(state: StftStreamState, chunk, *, nfft: int,
+                     hop: int | None = None, window=None):
+    """One chunk -> (state', spec (..., chunk//hop, nfft//2+1) complex).
+
+    Chunk length must be a multiple of ``hop`` (frames stay aligned to
+    global hop multiples). Dropping the first
+    :func:`stft_stream_warmup` frames of the concatenated step outputs
+    reproduces ``ops.stft`` on the whole stream exactly — the streaming
+    form of the gather-free framing (ops/spectral.py), with the frame
+    overlap carried instead of re-read.
+    """
+    from veles.simd_tpu.ops import spectral
+
+    hop = nfft // 4 if hop is None else hop
+    chunk = jnp.asarray(chunk, jnp.float32)
+    if chunk.shape[-1] % hop or chunk.shape[-1] < hop:
+        raise ValueError(
+            f"chunk length {chunk.shape[-1]} must be a positive multiple "
+            f"of hop {hop}")
+    if state.carry.shape[-1] != nfft - hop:
+        raise ValueError(
+            f"state carry length {state.carry.shape[-1]} != nfft - hop "
+            f"= {nfft - hop}; init and step must agree on (nfft, hop)")
+    _check_stream_batch(state.carry, chunk, "stft_stream_init")
+    z = jnp.concatenate([state.carry, chunk], axis=-1)
+    spec = spectral.stft(z, nfft=nfft, hop=hop, window=window)
+    return StftStreamState(z[..., z.shape[-1] - (nfft - hop):]), spec
+
+
+# ---------------------------------------------------------------------------
 # scan driver
 # ---------------------------------------------------------------------------
 
