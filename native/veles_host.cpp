@@ -13,6 +13,7 @@
 // C ABI only — consumed from Python via ctypes (no pybind11 in the image).
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -461,4 +462,166 @@ VH_API int vh_stream_close(int64_t handle) {
   return 0;
 }
 
-VH_API int vh_abi_version() { return 2; }
+// ---------------------------------------------------------------------------
+// Real-time ingestion ring buffer (float32 samples).
+//
+// The runtime front door of the streaming layer (veles/simd_tpu/ops/
+// stream.py): a producer (socket reader, ADC callback, decoder thread)
+// pushes packets of ANY size; the consumer pops fixed hop-aligned
+// chunks for the jitted stream steps.  The reference has no runtime at
+// all between calls (its overlap is re-fed by the caller,
+// /root/reference/src/convolve.c:181-228); here the chunk assembly is
+// native, like the rest of the host runtime.
+//
+// Single mutex + two condvars (same discipline as Stream above): pushes
+// and pops are memcpys, contention is negligible against device-step
+// cost.  Non-blocking push (returns samples accepted; the rest counts
+// as dropped — real-time semantics, the producer must not stall), pop
+// with optional timeout.  int16 pushes convert in-place on the way in
+// (the reference's int16 front door, inc/simd/arithmetic-inl.h:43-85).
+
+namespace {
+struct Ring {
+  std::mutex mu;
+  std::condition_variable cv_data;
+  float* buf = nullptr;
+  size_t cap = 0;        // samples
+  size_t head = 0;       // read position
+  size_t count = 0;      // samples buffered
+  size_t chunk = 0;      // pop granularity
+  uint64_t pushed = 0;
+  uint64_t dropped = 0;
+  bool closed = false;   // producer done
+};
+std::mutex g_rings_mu;
+std::vector<Ring*> g_rings;
+
+Ring* ring_from_handle(int64_t h) {
+  std::lock_guard<std::mutex> lock(g_rings_mu);
+  if (h < 0 || static_cast<size_t>(h) >= g_rings.size()) return nullptr;
+  return g_rings[static_cast<size_t>(h)];
+}
+
+// Copy n samples in (converting if src16) under the lock; returns accepted.
+template <typename Src>
+size_t ring_push_impl(Ring* r, const Src* data, size_t n) {
+  std::unique_lock<std::mutex> lock(r->mu);
+  if (r->closed || !r->buf) return 0;
+  size_t space = r->cap - r->count;
+  size_t take = n < space ? n : space;
+  size_t w = (r->head + r->count) % r->cap;
+  for (size_t i = 0; i < take; ++i) {  // two memcpy-able arcs for float,
+    r->buf[w] = static_cast<float>(data[i]);  // but the convert path
+    w = w + 1 == r->cap ? 0 : w + 1;          // needs the loop anyway
+  }
+  r->count += take;
+  r->pushed += take;
+  r->dropped += n - take;
+  if (r->count >= r->chunk) r->cv_data.notify_one();
+  return take;
+}
+}  // namespace
+
+VH_API int64_t vh_ring_create(size_t capacity_samples, size_t chunk_len) {
+  if (chunk_len == 0 || capacity_samples < chunk_len) return -1;
+  Ring* r = new (std::nothrow) Ring();
+  if (!r) return -1;
+  r->buf = static_cast<float*>(malloc(capacity_samples * sizeof(float)));
+  if (!r->buf) {
+    delete r;
+    return -1;
+  }
+  r->cap = capacity_samples;
+  r->chunk = chunk_len;
+  std::lock_guard<std::mutex> lock(g_rings_mu);
+  g_rings.push_back(r);
+  return static_cast<int64_t>(g_rings.size() - 1);
+}
+
+VH_API int64_t vh_ring_push_f32(int64_t h, const float* data, size_t n) {
+  Ring* r = ring_from_handle(h);
+  return r ? static_cast<int64_t>(ring_push_impl(r, data, n)) : -1;
+}
+
+VH_API int64_t vh_ring_push_i16(int64_t h, const int16_t* data, size_t n) {
+  Ring* r = ring_from_handle(h);
+  return r ? static_cast<int64_t>(ring_push_impl(r, data, n)) : -1;
+}
+
+// 1 = chunk copied out; 0 = timeout / not enough data; -1 = closed and
+// fewer than chunk samples remain (drain the tail with vh_ring_pop_tail).
+VH_API int vh_ring_pop_chunk(int64_t h, float* out, int timeout_ms) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::unique_lock<std::mutex> lock(r->mu);
+  if (!r->buf) return -1;
+  auto have = [&] { return r->count >= r->chunk || r->closed; };
+  if (timeout_ms > 0) {
+    r->cv_data.wait_for(lock, std::chrono::milliseconds(timeout_ms), have);
+  }
+  if (r->count < r->chunk) return r->closed ? -1 : 0;
+  size_t first = r->cap - r->head;
+  if (first > r->chunk) first = r->chunk;
+  memcpy(out, r->buf + r->head, first * sizeof(float));
+  if (first < r->chunk)
+    memcpy(out + first, r->buf, (r->chunk - first) * sizeof(float));
+  r->head = (r->head + r->chunk) % r->cap;
+  r->count -= r->chunk;
+  return 1;
+}
+
+// Drain up to max_n remaining samples after the producer closed;
+// returns the number copied (bounded by the caller's buffer — the ring
+// may still hold whole undrained chunks at close time).
+VH_API int64_t vh_ring_pop_tail(int64_t h, float* out, size_t max_n) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::lock_guard<std::mutex> lock(r->mu);
+  if (!r->buf || !r->closed) return -1;
+  size_t n = r->count < max_n ? r->count : max_n;
+  for (size_t i = 0; i < n; ++i)
+    out[i] = r->buf[(r->head + i) % r->cap];
+  r->head = (r->head + n) % r->cap;
+  r->count -= n;
+  return static_cast<int64_t>(n);
+}
+
+VH_API int64_t vh_ring_available(int64_t h) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::lock_guard<std::mutex> lock(r->mu);
+  return static_cast<int64_t>(r->count);
+}
+
+VH_API int64_t vh_ring_dropped(int64_t h) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::lock_guard<std::mutex> lock(r->mu);
+  return static_cast<int64_t>(r->dropped);
+}
+
+// Producer end-of-stream: consumers drain buffered chunks, then the tail.
+VH_API int vh_ring_close(int64_t h) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::lock_guard<std::mutex> lock(r->mu);
+  r->closed = true;
+  r->cv_data.notify_all();
+  return 0;
+}
+
+// Same stale-handle policy as pools/streams: the Ring struct persists,
+// the sample buffer is freed.
+VH_API int vh_ring_destroy(int64_t h) {
+  Ring* r = ring_from_handle(h);
+  if (!r) return -1;
+  std::lock_guard<std::mutex> lock(r->mu);
+  r->closed = true;
+  free(r->buf);
+  r->buf = nullptr;
+  r->count = 0;
+  r->cv_data.notify_all();  // wake any consumer blocked in pop_chunk
+  return 0;
+}
+
+VH_API int vh_abi_version() { return 3; }

@@ -40,6 +40,7 @@ MODULES = [
     "veles.simd_tpu.host",
     "veles.simd_tpu.host.feed",
     "veles.simd_tpu.host.io",
+    "veles.simd_tpu.host.ring",
     "veles.simd_tpu.wavelet_data",
     "veles.simd_tpu.compat",
     "veles.simd_tpu.parallel.mesh",

@@ -21,7 +21,7 @@ _LOCK = threading.Lock()
 _LIB = None
 _TRIED = False
 
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 
 def _build(src: str, out: str) -> bool:
@@ -81,6 +81,24 @@ def _signatures(lib: ctypes.CDLL) -> None:
     lib.vh_stream_file_size.argtypes = [c.c_int64]
     lib.vh_stream_close.restype = c.c_int
     lib.vh_stream_close.argtypes = [c.c_int64]
+    lib.vh_ring_create.restype = c.c_int64
+    lib.vh_ring_create.argtypes = [c.c_size_t, c.c_size_t]
+    lib.vh_ring_push_f32.restype = c.c_int64
+    lib.vh_ring_push_f32.argtypes = [c.c_int64, c.c_void_p, c.c_size_t]
+    lib.vh_ring_push_i16.restype = c.c_int64
+    lib.vh_ring_push_i16.argtypes = [c.c_int64, c.c_void_p, c.c_size_t]
+    lib.vh_ring_pop_chunk.restype = c.c_int
+    lib.vh_ring_pop_chunk.argtypes = [c.c_int64, c.c_void_p, c.c_int]
+    lib.vh_ring_pop_tail.restype = c.c_int64
+    lib.vh_ring_pop_tail.argtypes = [c.c_int64, c.c_void_p, c.c_size_t]
+    for name in ("vh_ring_available", "vh_ring_dropped"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_int64]
+    for name in ("vh_ring_close", "vh_ring_destroy"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int
+        fn.argtypes = [c.c_int64]
     lib.vh_abi_version.restype = c.c_int
     lib.vh_abi_version.argtypes = []
 

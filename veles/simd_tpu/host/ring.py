@@ -1,0 +1,198 @@
+"""Real-time ingestion ring buffer: packets in, hop-aligned chunks out.
+
+The runtime front door of the streaming layer (ops/stream.py). A
+producer thread pushes packets of any size (float32 or int16 — the
+int16 path converts natively on the way in, the reference's front door
+dtype, inc/simd/arithmetic-inl.h:43-85); the consumer pops fixed
+``chunk_len`` chunks sized for the jitted stream steps.
+
+Native C++ implementation (native/veles_host.cpp, mutex + condvar,
+non-blocking push with overrun accounting) with a pure-NumPy fallback
+of identical semantics when the toolchain is unavailable
+(``VELES_NO_NATIVE=1``).
+
+    ring = RingBuffer(chunk_len=1024, capacity=1 << 16)
+    # producer thread:           # consumer loop:
+    ring.push(packet)            chunk = ring.pop(timeout=0.1)
+    ...                          state, y = fir_stream_step(state, chunk, h)
+    ring.close()                 ...; tail = ring.tail()
+
+Push is non-blocking by design — a real-time producer must never stall;
+samples that do not fit are counted in ``dropped`` (overrun), the
+standard soft-real-time contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from veles.simd_tpu.host import _native
+
+
+class RingBuffer:
+    """SPSC-style sample ring; see module docstring."""
+
+    def __init__(self, chunk_len: int, capacity: int | None = None):
+        if chunk_len < 1:
+            raise ValueError("chunk_len must be >= 1")
+        capacity = 16 * chunk_len if capacity is None else int(capacity)
+        if capacity < chunk_len:
+            raise ValueError("capacity must be >= chunk_len")
+        self.chunk_len = int(chunk_len)
+        self.capacity = capacity
+        self._closed_flag = False
+        self._lib = _native.load()
+        if self._lib is not None:
+            self._h = self._lib.vh_ring_create(capacity, chunk_len)
+            if self._h < 0:
+                raise MemoryError("vh_ring_create failed")
+        else:
+            self._buf = np.empty(capacity, np.float32)
+            self._head = 0
+            self._count = 0
+            self._dropped = 0
+            self._closed = False
+            self._cv = threading.Condition()
+
+    # -- producer side ----------------------------------------------------
+
+    def push(self, samples) -> int:
+        """Append samples (float32/float64/int16 1-D array); returns how
+        many were accepted (the rest count as dropped)."""
+        a = np.ascontiguousarray(samples)
+        if a.ndim != 1:
+            raise ValueError("push expects a 1-D packet")
+        if self._lib is not None:
+            if a.dtype == np.int16:
+                return int(self._lib.vh_ring_push_i16(
+                    self._h, a.ctypes.data, a.size))
+            a = a.astype(np.float32, copy=False)
+            return int(self._lib.vh_ring_push_f32(
+                self._h, a.ctypes.data, a.size))
+        a = a.astype(np.float32, copy=False)
+        with self._cv:
+            if self._closed:
+                return 0
+            space = self.capacity - self._count
+            take = min(a.size, space)
+            w = (self._head + self._count) % self.capacity
+            first = min(take, self.capacity - w)
+            self._buf[w:w + first] = a[:first]
+            self._buf[:take - first] = a[first:take]
+            self._count += take
+            self._dropped += a.size - take
+            if self._count >= self.chunk_len:
+                self._cv.notify()
+            return take
+
+    def close(self) -> None:
+        """Producer end-of-stream: buffered chunks then :meth:`tail`
+        remain poppable."""
+        self._closed_flag = True
+        if self._lib is not None:
+            self._lib.vh_ring_close(self._h)
+            return
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- consumer side ----------------------------------------------------
+
+    def pop(self, timeout: float = 0.0):
+        """One ``chunk_len`` float32 chunk, or None when not enough data
+        arrived within ``timeout`` seconds (None also after close() once
+        fewer than chunk_len samples remain — drain with :meth:`tail`)."""
+        out = np.empty(self.chunk_len, np.float32)
+        if self._lib is not None:
+            # never truncate a positive timeout to a 0 ms poll (the
+            # fallback honors sub-ms waits; semantics must match)
+            ms = max(1, round(timeout * 1000)) if timeout > 0 else 0
+            r = self._lib.vh_ring_pop_chunk(self._h, out.ctypes.data, ms)
+            return out if r == 1 else None
+        with self._cv:
+            if timeout > 0:
+                self._cv.wait_for(
+                    lambda: self._count >= self.chunk_len or self._closed,
+                    timeout)
+            if self._count < self.chunk_len:
+                return None
+            idx = (self._head + np.arange(self.chunk_len)) % self.capacity
+            out[:] = self._buf[idx]
+            self._head = (self._head + self.chunk_len) % self.capacity
+            self._count -= self.chunk_len
+            return out
+
+    def tail(self):
+        """ALL remaining samples after close() — usually the sub-chunk
+        remainder, but whole undrained chunks too if the consumer stopped
+        early; float32 array (possibly empty). Raises if the producer
+        has not closed."""
+        if self._lib is not None:
+            n_avail = max(self.available, 0)
+            out = np.empty(max(n_avail, 1), np.float32)
+            n = self._lib.vh_ring_pop_tail(self._h, out.ctypes.data,
+                                           out.size)
+            if n < 0:
+                raise RuntimeError("tail() before close()")
+            return out[:n].copy()
+        with self._cv:
+            if not self._closed:
+                raise RuntimeError("tail() before close()")
+            n = self._count
+            idx = (self._head + np.arange(n)) % self.capacity
+            out = self._buf[idx].copy()
+            self._head = (self._head + n) % self.capacity
+            self._count = 0
+            return out
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def available(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.vh_ring_available(self._h))
+        with self._cv:
+            return self._count
+
+    @property
+    def dropped(self) -> int:
+        """Samples offered but rejected because the ring was full
+        (overruns). Counted per push call: a producer that retries
+        leftovers accumulates its retried samples here too — for a
+        true loss figure, push each sample range once."""
+        if self._lib is not None:
+            return int(self._lib.vh_ring_dropped(self._h))
+        with self._cv:
+            return self._dropped
+
+    def __iter__(self):
+        """Drain as an iterator of chunks (blocks 100 ms per wait) until
+        the producer closes; the sub-chunk tail is NOT yielded — fetch it
+        with :meth:`tail` if the model can handle ragged ends."""
+        while True:
+            c = self.pop(timeout=0.1)
+            if c is not None:
+                yield c
+            elif self._is_closed_and_drained():
+                return
+
+    def _is_closed_and_drained(self) -> bool:
+        # the flag is wrapper-local (close() goes through this object);
+        # a second pop here could swallow a late-arriving chunk, so the
+        # check must not touch the ring itself
+        return self._closed_flag and self.available < self.chunk_len
+
+    def destroy(self) -> None:
+        self._closed_flag = True  # iterators must terminate, not spin
+        if self._lib is not None:
+            self._lib.vh_ring_destroy(self._h)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        self.destroy()
+        return False
