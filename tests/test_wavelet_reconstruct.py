@@ -109,3 +109,72 @@ def test_odd_length_lane_interleave(rng):
     back = np.asarray(ops.wavelet_reconstruct(hi, lo, "daubechies", 4,
                                               impl="xla"))
     np.testing.assert_allclose(back, x, atol=2e-5)
+
+
+class TestWaveletPackets:
+    """Full binary filter-bank tree (beyond-parity, ops/wavelet.py)."""
+
+    @pytest.mark.parametrize("wtype,order", [("daubechies", 8),
+                                             ("daubechies", 2),
+                                             ("symlet", 8), ("coiflet", 6)])
+    @pytest.mark.parametrize("levels", [1, 2, 3])
+    def test_perfect_reconstruction(self, rng, wtype, order, levels):
+        x = rng.standard_normal(256).astype(np.float32)
+        bands = ops.wavelet_packet_decompose(x, levels, wtype, order)
+        assert bands.shape == (1 << levels, 256 >> levels)
+        y = np.asarray(ops.wavelet_packet_reconstruct(bands, wtype, order))
+        np.testing.assert_allclose(y, x, atol=2e-4)
+
+    def test_level1_is_wavelet_apply(self, rng):
+        x = rng.standard_normal(128).astype(np.float32)
+        bands = np.asarray(ops.wavelet_packet_decompose(x, 1))
+        hi, lo = ops.wavelet_apply(x)
+        np.testing.assert_array_equal(bands[0], np.asarray(lo))
+        np.testing.assert_array_equal(bands[1], np.asarray(hi))
+
+    def test_matches_naive_recursion(self, rng):
+        """The batched tree equals splitting every band one at a time
+        with the public per-band op (natural/Paley order)."""
+        x = rng.standard_normal(256).astype(np.float32)
+        got = np.asarray(ops.wavelet_packet_decompose(x, 3, "daubechies", 4))
+        bands = [x]
+        for _ in range(3):
+            nxt = []
+            for b in bands:
+                hi, lo = ops.wavelet_apply(b, "daubechies", 4)
+                nxt.extend([np.asarray(lo), np.asarray(hi)])
+            bands = nxt
+        np.testing.assert_allclose(got, np.stack(bands), atol=1e-5)
+
+    def test_matches_reference_oracle(self, rng):
+        x = rng.standard_normal(128).astype(np.float32)
+        got = np.asarray(ops.wavelet_packet_decompose(x, 2, "daubechies", 8))
+        want = ops.wavelet_packet_decompose(x, 2, "daubechies", 8,
+                                            impl="reference")
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        back = ops.wavelet_packet_reconstruct(want, "daubechies", 8,
+                                              impl="reference")
+        np.testing.assert_allclose(back, x, atol=1e-6)
+
+    def test_energy_preserved_daubechies(self, rng):
+        """db filters are orthonormal in the shipped normalization: the
+        packet tree is an orthogonal transform under periodic extension."""
+        x = rng.standard_normal(512).astype(np.float32)
+        bands = np.asarray(ops.wavelet_packet_decompose(x, 3, "daubechies", 8))
+        np.testing.assert_allclose((bands ** 2).sum(), (x ** 2).sum(),
+                                   rtol=1e-4)
+
+    def test_batched(self, rng):
+        x = rng.standard_normal((5, 128)).astype(np.float32)
+        bands = ops.wavelet_packet_decompose(x, 2)
+        assert bands.shape == (5, 4, 32)
+        y = np.asarray(ops.wavelet_packet_reconstruct(bands))
+        np.testing.assert_allclose(y, x, atol=2e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="levels"):
+            ops.wavelet_packet_decompose(np.zeros(64, np.float32), 0)
+        with pytest.raises(ValueError, match="divisible"):
+            ops.wavelet_packet_decompose(np.zeros(100, np.float32), 3)
+        with pytest.raises(ValueError, match="2\\^levels"):
+            ops.wavelet_packet_reconstruct(np.zeros((3, 16), np.float32))

@@ -28,6 +28,7 @@ from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,
     stationary_wavelet_recompose, stationary_wavelet_reconstruct,
     wavelet_allocate_destination, wavelet_apply, wavelet_decompose,
+    wavelet_packet_decompose, wavelet_packet_reconstruct,
     wavelet_prepare_array, wavelet_recompose, wavelet_reconstruct,
     wavelet_recycle_source, wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401
