@@ -432,20 +432,17 @@ def wavelet_packet_decompose(src, levels, wavelet_type="daubechies",
     if n % (1 << levels) != 0:
         raise ValueError(
             f"length {n} must be divisible by 2^levels = {1 << levels}")
-    if impl == "reference":
-        # the float64 oracle is 1-D per band: recurse explicitly
-        bands = [x]
-        for _ in range(levels):
-            nxt = []
-            for b in bands:
-                hi, lo = _ref.wavelet_apply(b, wavelet_type, order, ext)
-                nxt.extend([lo, hi])
-            bands = nxt
-        return np.stack(bands, axis=-2)
+    # one batched dual-bank pass per level, either backend (the float64
+    # oracle is batch-capable too — same tree, np instead of jnp)
+    xp = np if impl == "reference" else jnp
+    apply = (functools.partial(_ref.wavelet_apply, wavelet_type=wavelet_type,
+                               order=order, ext=ext)
+             if impl == "reference" else
+             lambda b: wavelet_apply(b, wavelet_type, order, ext, impl=impl))
     bands = x[..., None, :]                     # (..., 1, n)
     for _ in range(levels):
-        hi, lo = wavelet_apply(bands, wavelet_type, order, ext, impl=impl)
-        bands = jnp.stack([lo, hi], axis=-2)    # (..., B, 2, half)
+        hi, lo = apply(bands)
+        bands = xp.stack([lo, hi], axis=-2)     # (..., B, 2, half)
         bands = bands.reshape(*bands.shape[:-3], -1, bands.shape[-1])
     return bands
 
@@ -458,25 +455,19 @@ def wavelet_packet_reconstruct(bands, wavelet_type="daubechies", order=8,
     impl = resolve_impl(impl)
     bands = np.asarray(bands, np.float64) if impl == "reference" \
         else jnp.asarray(bands, jnp.float32)
-    if bands.ndim < 2 or bands.shape[-2] & (bands.shape[-2] - 1):
+    nb = bands.shape[-2] if bands.ndim >= 2 else 0
+    if bands.ndim < 2 or nb < 1 or nb & (nb - 1):
         raise ValueError("bands must be (..., 2^levels, m)")
-    if impl == "reference":
-        b = bands
-        while b.shape[-2] > 1:
-            pairs = [
-                _ref.wavelet_reconstruct(b[..., 2 * i + 1, :],
-                                         b[..., 2 * i, :],
-                                         wavelet_type, order, ext)
-                for i in range(b.shape[-2] // 2)]
-            b = np.stack(pairs, axis=-2)
-        return b[..., 0, :]
+    recon = (functools.partial(_ref.wavelet_reconstruct,
+                               wavelet_type=wavelet_type, order=order,
+                               ext=ext)
+             if impl == "reference" else
+             lambda h, l: wavelet_reconstruct(h, l, wavelet_type, order,
+                                              ext, impl=impl))
     while bands.shape[-2] > 1:
         half = bands.shape[-2] // 2
         pairs = bands.reshape(*bands.shape[:-2], half, 2, bands.shape[-1])
-        lo = pairs[..., 0, :]
-        hi = pairs[..., 1, :]
-        bands = wavelet_reconstruct(hi, lo, wavelet_type, order, ext,
-                                    impl=impl)
+        bands = recon(pairs[..., 1, :], pairs[..., 0, :])
     return bands[..., 0, :]
 
 
