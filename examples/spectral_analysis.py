@@ -4,10 +4,11 @@ Welch PSD peak reading.
 
     python examples/spectral_analysis.py
 
-A two-tone signal buried in noise is (1) spectrally denoised by soft
-magnitude masking in STFT space and reconstructed with the exact
-overlap-add inverse, and (2) measured with the Welch PSD and the
-SpectralPeakAnalyzer model for sub-bin frequency estimates.
+A two-tone signal buried in noise is (1) spectrally denoised by hard
+binary gating in STFT space (keep a bin only above 3x the per-frame
+noise floor) and reconstructed with the exact overlap-add inverse, and
+(2) measured with the Welch PSD and the SpectralPeakAnalyzer model for
+sub-bin frequency estimates.
 """
 
 import sys

@@ -39,7 +39,9 @@ from veles.simd_tpu.ops.spectral import (  # noqa: F401
     frame, hann_window, istft, overlap_add, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, MinMaxStreamState, PeaksStreamState, StftStreamState,
-    SwtStreamState, fir_stream_init, fir_stream_step, minmax_stream_init,
-    minmax_stream_step, peaks_stream_init, peaks_stream_step,
-    stft_stream_init, stft_stream_step, stft_stream_warmup, stream_scan,
-    swt_stream_delay, swt_stream_init, swt_stream_step)
+    SwtStreamReconState, SwtStreamState, fir_stream_init, fir_stream_step,
+    minmax_stream_init, minmax_stream_step, peaks_stream_init,
+    peaks_stream_step, stft_stream_init, stft_stream_step,
+    stft_stream_warmup, stream_scan, swt_stream_delay, swt_stream_init,
+    swt_stream_reconstruct_init, swt_stream_reconstruct_step,
+    swt_stream_step)

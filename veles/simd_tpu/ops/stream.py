@@ -231,11 +231,80 @@ def swt_stream_step(state: SwtStreamState, chunk,
     filters = jnp.asarray(np.stack([hi, lo]))
     stride = 1 << (level - 1)
     _check_stream_batch(state.tail, chunk, "swt_stream_init")
+    d = state.tail.shape[-1]
+    if d != stride * (order - 1):
+        raise ValueError(
+            f"state carry length {d} != (order-1)*2^(level-1) = "
+            f"{stride * (order - 1)}; init and step must agree on "
+            f"(order, level)")
     z = jnp.concatenate([state.tail, chunk], axis=-1)
     out_hi, out_lo = _swt_bank(z, filters, stride, chunk.shape[-1])
-    d = state.tail.shape[-1]
     new_tail = z[..., z.shape[-1] - d:]
     return SwtStreamState(new_tail), (out_hi, out_lo)
+
+
+class SwtStreamReconState(NamedTuple):
+    """Carry for streaming SWT synthesis: the last ``D`` samples of each
+    band, ``D = (order-1) * 2**(level-1)`` (the synthesis bank is
+    backward-looking, so it needs no extra latency of its own)."""
+    tail_hi: jax.Array
+    tail_lo: jax.Array
+
+
+def swt_stream_reconstruct_init(order, level=1,
+                                batch_shape=()) -> SwtStreamReconState:
+    """Start-of-stream synthesis state (zero band prehistory)."""
+    d = swt_stream_delay(order, level)
+    z = jnp.zeros((*batch_shape, d), jnp.float32)
+    return SwtStreamReconState(z, z)
+
+
+@functools.partial(jax.jit, static_argnames=("wavelet_type", "order",
+                                             "level"))
+def swt_stream_reconstruct_step(state: SwtStreamReconState, chunk_hi,
+                                chunk_lo, wavelet_type="daubechies",
+                                order=8, level=1):
+    """One chunk of (hi, lo) band samples -> (state', x_chunk).
+
+    The whole-signal synthesis bank is already causal
+    (x[m] = gain * sum_j f[j] * band[m - s*j],
+    _stationary_reconstruct_xla in ops/wavelet.py), so streaming it
+    adds NO latency of its own: fed with the outputs of
+    :func:`swt_stream_step`, the concatenated reconstruction equals
+    the input stream delayed by ``swt_stream_delay(order, level)`` —
+    the analysis delay alone — exactly (orthogonal-family identity),
+    past a ``2*delay`` warm-up (the analysis warm-up propagated
+    through the synthesis span).
+    """
+    from veles.simd_tpu.ops.wavelet import _recon_filters
+
+    filters, c = _recon_filters(wavelet_type, order)  # one gain source
+    gain = jnp.float32(1.0 / (2.0 * c))
+    stride = 1 << (level - 1)
+    chunk_hi = jnp.asarray(chunk_hi, jnp.float32)
+    chunk_lo = jnp.asarray(chunk_lo, jnp.float32)
+    if chunk_hi.shape != chunk_lo.shape:
+        raise ValueError("hi and lo chunks must have the same shape")
+    _check_stream_batch(state.tail_hi, chunk_hi,
+                        "swt_stream_reconstruct_init")
+    d = state.tail_hi.shape[-1]
+    if d != stride * (order - 1):
+        raise ValueError(
+            f"state carry length {d} != (order-1)*2^(level-1) = "
+            f"{stride * (order - 1)}; init and step must agree on "
+            f"(order, level)")
+    z_hi = jnp.concatenate([state.tail_hi, chunk_hi], axis=-1)
+    z_lo = jnp.concatenate([state.tail_lo, chunk_lo], axis=-1)
+    n = chunk_hi.shape[-1]
+    out = jnp.zeros_like(chunk_hi)
+    # x[m] = gain * sum_j f[j] * band[m - s*j]: z index m + d - s*j
+    for j in range(order):
+        start = d - stride * j
+        out = out + z_lo[..., start:start + n] * filters[1, j] \
+                  + z_hi[..., start:start + n] * filters[0, j]
+    new = SwtStreamReconState(z_hi[..., z_hi.shape[-1] - d:],
+                              z_lo[..., z_lo.shape[-1] - d:])
+    return new, out * gain
 
 
 # ---------------------------------------------------------------------------
