@@ -1,6 +1,7 @@
 """Composed model tests: matched filter finds injected templates, the
 denoiser actually denoises, the flagship pipeline jits and batches."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -162,3 +163,86 @@ class TestSpectralPeakAnalyzer:
         # same dominant bin (40) despite slightly different Welch frames
         np.testing.assert_allclose(np.asarray(fa)[0], 40.0, atol=0.5)
         np.testing.assert_allclose(np.asarray(fb)[0], 40.0, atol=0.5)
+
+
+class TestStreamingWaveletDenoiser:
+    """Real-time shrinkage (models/streaming.py) vs the whole-signal
+    decompose -> threshold -> recompose pipeline."""
+
+    @pytest.mark.parametrize("order,levels", [(8, 3), (4, 2), (8, 1),
+                                              (4, 4)])
+    def test_matches_whole_signal(self, rng, order, levels):
+        from veles.simd_tpu import ops
+        from veles.simd_tpu.models import StreamingWaveletDenoiser
+
+        n, chunk, th = 4096, 256, 0.8
+        x = (np.sin(2 * np.pi * np.arange(n) / 64)
+             + 0.3 * rng.standard_normal(n)).astype(np.float32)
+        den = StreamingWaveletDenoiser("daubechies", order, levels, th)
+        s = den.latency
+        st = den.init()
+        outs = []
+        for i in range(0, n, chunk):
+            st, y = den.step(st, x[i:i + chunk])
+            outs.append(np.asarray(y))
+        got = np.concatenate(outs)
+
+        details, approx = ops.stationary_wavelet_decompose(
+            x, levels, "daubechies", order)
+        soft = lambda v: np.sign(v) * np.maximum(np.abs(v) - th, 0.0)
+        details = [soft(np.asarray(d)).astype(np.float32) for d in details]
+        want = np.asarray(ops.stationary_wavelet_recompose(
+            details, approx, "daubechies", order))
+        np.testing.assert_array_equal(got[2 * s:], want[s:n - s])
+
+    def test_batched_and_scan(self, rng):
+        import jax
+
+        from veles.simd_tpu.models import StreamingWaveletDenoiser
+
+        n, chunk = 2048, 256
+        x = rng.standard_normal((3, n)).astype(np.float32)
+        den = StreamingWaveletDenoiser(levels=2, thresholds=(0.5, 0.7))
+        st = den.init(batch_shape=(3,))
+        chunks = jnp.asarray(np.moveaxis(x.reshape(3, n // chunk, chunk),
+                                         1, 0))
+        _, ys = jax.lax.scan(lambda s, c: den.step(s, c), st, chunks)
+        y = np.moveaxis(np.asarray(ys), 0, 1).reshape(3, n)
+
+        st2 = den.init(batch_shape=(3,))
+        outs = []
+        for i in range(n // chunk):
+            st2, yy = den.step(st2, x[:, i * chunk:(i + 1) * chunk])
+            outs.append(np.asarray(yy))
+        np.testing.assert_array_equal(y, np.concatenate(outs, axis=-1))
+
+    def test_actually_denoises(self, rng):
+        from veles.simd_tpu.models import StreamingWaveletDenoiser
+
+        n = 8192
+        t = np.arange(n, dtype=np.float32)
+        clean = np.sin(2 * np.pi * t / 128).astype(np.float32)
+        x = (clean + 0.4 * rng.standard_normal(n)).astype(np.float32)
+        den = StreamingWaveletDenoiser(levels=3, thresholds=1.0)
+        st = den.init()
+        outs = []
+        for i in range(0, n, 512):
+            st, y = den.step(st, x[i:i + 512])
+            outs.append(np.asarray(y))
+        y = np.concatenate(outs)
+        s = den.latency
+
+        def snr(sig, ref):
+            return 10 * np.log10((ref ** 2).sum() / ((sig - ref) ** 2).sum())
+
+        before = snr(x[s:n - s], clean[s:n - s])
+        after = snr(y[2 * s:], clean[s:n - s])
+        assert after > before + 3.0, (before, after)
+
+    def test_validation(self):
+        from veles.simd_tpu.models import StreamingWaveletDenoiser
+
+        with pytest.raises(ValueError, match="levels"):
+            StreamingWaveletDenoiser(levels=0)
+        with pytest.raises(ValueError, match="thresholds"):
+            StreamingWaveletDenoiser(levels=3, thresholds=(1.0, 2.0))

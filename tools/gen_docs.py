@@ -34,6 +34,7 @@ MODULES = [
     "veles.simd_tpu.models.denoiser",
     "veles.simd_tpu.models.pipeline",
     "veles.simd_tpu.models.spectral",
+    "veles.simd_tpu.models.streaming",
     "veles.simd_tpu.shapes",
     "veles.simd_tpu.config",
     "veles.simd_tpu.contracts",

@@ -17,3 +17,4 @@ from veles.simd_tpu.models.matched_filter import MatchedFilterDetector  # noqa: 
 from veles.simd_tpu.models.denoiser import WaveletDenoiser  # noqa: F401
 from veles.simd_tpu.models.pipeline import SignalPipeline  # noqa: F401
 from veles.simd_tpu.models.spectral import SpectralPeakAnalyzer  # noqa: F401
+from veles.simd_tpu.models.streaming import StreamingWaveletDenoiser  # noqa: F401

@@ -1,0 +1,133 @@
+"""Real-time multi-level wavelet denoiser (streaming WaveletDenoiser).
+
+Composes the streaming SWT analysis/synthesis banks (ops/stream.py)
+into the shrinkage pipeline of models.WaveletDenoiser, chunk by chunk:
+
+    analysis level 1..L on the running approximation
+      -> soft-threshold each detail band
+      -> synthesis level L..1
+
+The subtlety a naive composition gets wrong is ALIGNMENT: the level-l
+bands lag the input by S_l = sum_{i<=l} D_i (D_i the level-i analysis
+delay), but synthesis at level l needs its hi band aligned with the
+approximation coming back down from level l+1, which lags S_L. Each hi
+band therefore passes through a pure delay line of S_L - S_l samples.
+Total pipeline latency: S_L = sum_i (order-1)*2^(i-1) samples — for
+db8 at 3 levels, 49 samples, independent of chunk size.
+
+Past a 2*S_L warm-up the streamed output equals the whole-signal
+shrinkage (stationary_wavelet_decompose -> soft threshold ->
+stationary_wavelet_recompose) exactly; the differential test in
+tests/test_stream.py is the contract.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.ops import stream as _stream
+
+
+class _DelayState(NamedTuple):
+    buf: jax.Array
+
+
+def _delay_init(d, batch_shape=()):
+    return _DelayState(jnp.zeros((*batch_shape, d), jnp.float32))
+
+
+def _delay_step(state, chunk):
+    """Pure delay by ``state.buf.shape[-1]`` samples (zero prehistory)."""
+    d = state.buf.shape[-1]
+    if d == 0:
+        return state, chunk
+    z = jnp.concatenate([state.buf, chunk], axis=-1)
+    return _DelayState(z[..., z.shape[-1] - d:]), z[..., :chunk.shape[-1]]
+
+
+class StreamingDenoiserState(NamedTuple):
+    analysis: tuple      # per-level SwtStreamState
+    delays: tuple        # per-level _DelayState for the hi bands
+    synthesis: tuple     # per-level SwtStreamReconState
+
+
+class StreamingWaveletDenoiser:
+    """Chunked soft-threshold wavelet shrinkage with fixed latency.
+
+        den = StreamingWaveletDenoiser("daubechies", 8, levels=3,
+                                       thresholds=(0.8, 0.8, 0.8))
+        state = den.init()
+        state, y = den.step(state, chunk)     # y lags input by den.latency
+
+    ``thresholds`` is one soft-shrinkage threshold per level (a scalar
+    broadcasts to every level). The step is jitted once per chunk shape
+    and batch-aware over leading axes (init with ``batch_shape=``).
+    """
+
+    def __init__(self, wavelet_type: str = "daubechies", order: int = 8,
+                 levels: int = 3, thresholds: float | Sequence[float] = 1.0):
+        if levels < 1:
+            raise ValueError("levels must be >= 1")
+        self.wavelet_type = wavelet_type
+        self.order = int(order)
+        self.levels = int(levels)
+        if np.isscalar(thresholds) or getattr(thresholds, "ndim", 1) == 0:
+            thresholds = (float(thresholds),) * levels
+        if len(thresholds) != levels:
+            raise ValueError(
+                f"{len(thresholds)} thresholds for {levels} levels")
+        self.thresholds = tuple(float(t) for t in thresholds)
+        self._dl = [_stream.swt_stream_delay(self.order, lv)
+                    for lv in range(1, levels + 1)]
+        #: total pipeline latency in samples (= the deepest band's lag)
+        self.latency = sum(self._dl)
+        self._step = jax.jit(self._step_impl)
+
+    def init(self, batch_shape=()) -> StreamingDenoiserState:
+        s_l = sum(self._dl)
+        run = 0
+        delays = []
+        for d in self._dl:
+            run += d
+            delays.append(_delay_init(s_l - run, batch_shape))
+        return StreamingDenoiserState(
+            analysis=tuple(
+                _stream.swt_stream_init(self.order, lv, batch_shape)
+                for lv in range(1, self.levels + 1)),
+            delays=tuple(delays),
+            synthesis=tuple(
+                _stream.swt_stream_reconstruct_init(self.order, lv,
+                                                    batch_shape)
+                for lv in range(1, self.levels + 1)))
+
+    def step(self, state: StreamingDenoiserState, chunk):
+        """One chunk in -> (state', denoised chunk delayed by latency)."""
+        return self._step(state, jnp.asarray(chunk, jnp.float32))
+
+    def _step_impl(self, state, chunk):
+        analysis, delays, synthesis = [], [], []
+        his = []
+        lo = chunk
+        for lv in range(1, self.levels + 1):
+            sa, (hi, lo) = _stream.swt_stream_step(
+                state.analysis[lv - 1], lo, self.wavelet_type, self.order,
+                lv)
+            t = jnp.float32(self.thresholds[lv - 1])
+            hi = jnp.sign(hi) * jnp.maximum(jnp.abs(hi) - t, 0.0)
+            dl, hi = _delay_step(state.delays[lv - 1], hi)
+            analysis.append(sa)
+            delays.append(dl)
+            his.append(hi)
+        cur = lo
+        for lv in range(self.levels, 0, -1):
+            sr, cur = _stream.swt_stream_reconstruct_step(
+                state.synthesis[lv - 1], his[lv - 1], cur,
+                self.wavelet_type, self.order, lv)
+            synthesis.append(sr)
+        synthesis.reverse()
+        return StreamingDenoiserState(tuple(analysis), tuple(delays),
+                                      tuple(synthesis)), cur

@@ -49,6 +49,16 @@ from veles.simd_tpu.ops.detect_peaks import (
 from veles.simd_tpu.ops.wavelet import _swt_bank
 
 
+def _check_swt_carry(d, order, level):
+    """Carry length must match the (order, level) the step was called
+    with — a mismatch would silently shift/clamp the filter windows."""
+    want = (1 << (level - 1)) * (order - 1)
+    if d != want:
+        raise ValueError(
+            f"state carry length {d} != (order-1)*2^(level-1) = {want}; "
+            f"init and step must agree on (order, level)")
+
+
 def _check_stream_batch(carry, chunk, init_name):
     """Carry batch must equal chunk batch — a state initialized without
     ``batch_shape`` cannot serve batched chunks (silent broadcasting
@@ -231,12 +241,8 @@ def swt_stream_step(state: SwtStreamState, chunk,
     filters = jnp.asarray(np.stack([hi, lo]))
     stride = 1 << (level - 1)
     _check_stream_batch(state.tail, chunk, "swt_stream_init")
+    _check_swt_carry(state.tail.shape[-1], order, level)
     d = state.tail.shape[-1]
-    if d != stride * (order - 1):
-        raise ValueError(
-            f"state carry length {d} != (order-1)*2^(level-1) = "
-            f"{stride * (order - 1)}; init and step must agree on "
-            f"(order, level)")
     z = jnp.concatenate([state.tail, chunk], axis=-1)
     out_hi, out_lo = _swt_bank(z, filters, stride, chunk.shape[-1])
     new_tail = z[..., z.shape[-1] - d:]
@@ -288,11 +294,7 @@ def swt_stream_reconstruct_step(state: SwtStreamReconState, chunk_hi,
     _check_stream_batch(state.tail_hi, chunk_hi,
                         "swt_stream_reconstruct_init")
     d = state.tail_hi.shape[-1]
-    if d != stride * (order - 1):
-        raise ValueError(
-            f"state carry length {d} != (order-1)*2^(level-1) = "
-            f"{stride * (order - 1)}; init and step must agree on "
-            f"(order, level)")
+    _check_swt_carry(d, order, level)
     z_hi = jnp.concatenate([state.tail_hi, chunk_hi], axis=-1)
     z_lo = jnp.concatenate([state.tail_lo, chunk_lo], axis=-1)
     n = chunk_hi.shape[-1]
