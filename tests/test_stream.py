@@ -350,3 +350,88 @@ def test_swt_stream_reconstruct_scan_batched(rng):
     _, ys = jax.lax.scan(step, (sa, sr), chunks)
     y = np.moveaxis(np.asarray(ys), 0, 1).reshape(3, n)
     np.testing.assert_allclose(y[:, 2 * d:], x[:, d:n - d], atol=2e-6)
+
+
+@pytest.mark.parametrize("nfft,hop,chunk", [(256, 64, 512), (512, 128, 512),
+                                            (128, 32, 128), (64, 16, 256)])
+def test_istft_stream_roundtrip(rng, nfft, hop, chunk):
+    """stft_stream -> istft_stream == input delayed by nfft - hop, past
+    an nfft-sample warm-up (partial window coverage at stream start)."""
+    n = 4096
+    x = rng.standard_normal(n, dtype=np.float32)
+    d = nfft - hop
+    sa = ops.stft_stream_init(nfft, hop)
+    sr = ops.istft_stream_init(nfft, hop)
+    outs = []
+    for c in _chunks(x, chunk):
+        sa, spec = ops.stft_stream_step(sa, c, nfft=nfft, hop=hop)
+        sr, y = ops.istft_stream_step(sr, spec, nfft=nfft, hop=hop)
+        outs.append(np.asarray(y))
+    y = np.concatenate(outs)
+    assert y.shape == x.shape  # one sample out per sample in
+    np.testing.assert_allclose(y[nfft:], x[nfft - d:n - d], atol=2e-6)
+
+
+def test_istft_stream_rect_unit_hop_nfft(rng):
+    """hop == nfft with a rectangular window: the pair is an exact
+    identity with zero latency (and the Hann zero-coverage guard emits
+    0 instead of NaN)."""
+    x = rng.standard_normal(1024, dtype=np.float32)
+    w = np.ones(64, np.float32)
+    sa = ops.stft_stream_init(64, 64)
+    sr = ops.istft_stream_init(64, 64)
+    outs = []
+    for c in _chunks(x, 256):
+        sa, spec = ops.stft_stream_step(sa, c, nfft=64, hop=64, window=w)
+        sr, y = ops.istft_stream_step(sr, spec, nfft=64, hop=64, window=w)
+        outs.append(np.asarray(y))
+    np.testing.assert_allclose(np.concatenate(outs), x, atol=2e-6)
+    # default Hann at hop==nfft: w[0]=0 -> that phase emits 0, not NaN
+    sa2 = ops.stft_stream_init(64, 64)
+    sr2 = ops.istft_stream_init(64, 64)
+    _, spec = ops.stft_stream_step(sa2, x[:256], nfft=64, hop=64)
+    _, y = ops.istft_stream_step(sr2, spec, nfft=64, hop=64)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_istft_stream_realtime_masking(rng):
+    """Real-time spectral gating: stream-masked == whole-signal-masked
+    (the masks see the same frames, shifted by the analysis warm-up)."""
+    n, nfft, hop, chunk = 4096, 256, 64, 512
+    t = np.arange(n, dtype=np.float32)
+    x = (np.sin(2 * np.pi * 20.0 / 256.0 * t)
+         + 1.0 * rng.standard_normal(n)).astype(np.float32)
+
+    def mask(spec):
+        mag = jnp.abs(spec)
+        floor = jnp.median(mag, axis=-1, keepdims=True)
+        return spec * (mag > 3.0 * floor)
+
+    sa = ops.stft_stream_init(nfft, hop)
+    sr = ops.istft_stream_init(nfft, hop)
+    outs = []
+    for c in _chunks(x, chunk):
+        sa, spec = ops.stft_stream_step(sa, c, nfft=nfft, hop=hop)
+        sr, y = ops.istft_stream_step(sr, mask(spec), nfft=nfft, hop=hop)
+        outs.append(np.asarray(y))
+    got = np.concatenate(outs)
+
+    spec_w = ops.stft(x, nfft=nfft, hop=hop)
+    want = np.asarray(ops.istft(mask(spec_w), nfft=nfft, hop=hop))
+    # streamed output lags by d = nfft-hop. Samples before 2d still
+    # overlap warm-up frames (zero-prehistory windows -> different
+    # medians -> different masks than the whole-signal frames), so the
+    # comparable interior starts at 2d; use 2*nfft for margin.
+    d = nfft - hop
+    lo, hi = 2 * nfft, n - nfft
+    np.testing.assert_allclose(got[lo:hi], want[lo - d:hi - d], atol=1e-5)
+
+
+def test_istft_stream_validation():
+    st = ops.istft_stream_init(128, 32)
+    with pytest.raises(ValueError, match="carry length"):
+        ops.istft_stream_step(st, jnp.zeros((2, 65), jnp.complex64),
+                              nfft=128, hop=64)
+    with pytest.raises(ValueError, match="window length"):
+        ops.istft_stream_step(st, jnp.zeros((2, 65), jnp.complex64),
+                              nfft=128, hop=32, window=np.ones(64))

@@ -38,8 +38,9 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     frame, hann_window, istft, overlap_add, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
-    FirStreamState, MinMaxStreamState, PeaksStreamState, StftStreamState,
-    SwtStreamReconState, SwtStreamState, fir_stream_init, fir_stream_step,
+    FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
+    StftStreamState, SwtStreamReconState, SwtStreamState, fir_stream_init,
+    fir_stream_step, istft_stream_init, istft_stream_step,
     minmax_stream_init, minmax_stream_step, peaks_stream_init,
     peaks_stream_step, stft_stream_init, stft_stream_step,
     stft_stream_warmup, stream_scan, swt_stream_delay, swt_stream_init,

@@ -370,6 +370,67 @@ def stft_stream_step(state: StftStreamState, chunk, *, nfft: int,
     return StftStreamState(z[..., z.shape[-1] - (nfft - hop):]), spec
 
 
+class IstftStreamState(NamedTuple):
+    """Carry for streaming inverse STFT: the trailing ``nfft - hop``
+    samples of the running overlap-add accumulation (frames that will
+    also receive contributions from frames yet to arrive)."""
+    carry: jax.Array
+
+
+def istft_stream_init(nfft: int, hop: int | None = None,
+                      batch_shape=()) -> IstftStreamState:
+    """Start-of-stream synthesis state (empty accumulation)."""
+    hop = nfft // 4 if hop is None else hop
+    stft_stream_warmup(nfft, hop)  # validates the pair
+    return IstftStreamState(
+        jnp.zeros((*batch_shape, nfft - hop), jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop"))
+def istft_stream_step(state: IstftStreamState, spec, *, nfft: int,
+                      hop: int | None = None, window=None):
+    """One chunk of frames (..., F_c, nfft//2+1) -> (state', samples
+    (..., F_c*hop)).
+
+    The streaming half of ``ops.istft``: frames overlap-add into a
+    running accumulation; a sample is emitted once every frame that
+    touches it has arrived, normalized by the steady-state squared-
+    window overlap (hop-periodic, so it is a trace-time constant).
+    Fed from :func:`stft_stream_step` (optionally through a spectral
+    mask), the concatenated output equals the input stream delayed by
+    ``nfft - hop`` samples wherever the steady-state window coverage is
+    complete — real-time spectral processing with fixed latency.
+    """
+    from veles.simd_tpu.ops import spectral
+
+    hop = nfft // 4 if hop is None else hop
+    stft_stream_warmup(nfft, hop)  # validates nfft % hop == 0
+    window = spectral.hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    if window.shape[-1] != nfft:
+        raise ValueError(f"window length {window.shape[-1]} != nfft {nfft}")
+    if state.carry.shape[-1] != nfft - hop:
+        raise ValueError(
+            f"state carry length {state.carry.shape[-1]} != nfft - hop "
+            f"= {nfft - hop}; init and step must agree on (nfft, hop)")
+    spec = jnp.asarray(spec)
+    frames = jnp.fft.irfft(spec, n=nfft, axis=-1) * window
+    _check_stream_batch(state.carry, frames[..., 0, :],
+                        "istft_stream_init")
+    acc = spectral.overlap_add(frames, hop)       # (..., (F_c-1)*hop+nfft)
+    n_emit = frames.shape[-2] * hop
+    acc = jnp.concatenate(
+        [acc[..., :nfft - hop] + state.carry, acc[..., nfft - hop:]],
+        axis=-1)
+    # steady-state squared-window overlap, hop-periodic (trace constant);
+    # zero-coverage positions emit 0, matching ops.istft
+    den = jnp.sum((window * window).reshape(nfft // hop, hop), axis=0)
+    den = jnp.tile(den, n_emit // hop)
+    eps = jnp.float32(1e-12)
+    out = acc[..., :n_emit] / jnp.maximum(den, eps) * (den > eps)
+    return IstftStreamState(acc[..., n_emit:]), out
+
+
 # ---------------------------------------------------------------------------
 # scan driver
 # ---------------------------------------------------------------------------
