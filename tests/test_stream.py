@@ -435,3 +435,55 @@ def test_istft_stream_validation():
     with pytest.raises(ValueError, match="window length"):
         ops.istft_stream_step(st, jnp.zeros((2, 65), jnp.complex64),
                               nfft=128, hop=32, window=np.ones(64))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_irregular_chunking(rng, seed):
+    """Random segmentation must not change any stream's output: every
+    uniform-chunk differential above, re-run with chunks of random
+    lengths (the real producer case — packets arrive ragged)."""
+    g = np.random.default_rng(seed)
+    n = 2048
+    x = rng.standard_normal(n, dtype=np.float32)
+    # few cuts: each unique segment length costs a retrace
+    cuts = np.sort(g.choice(np.arange(1, n), size=g.integers(3, 9),
+                            replace=False))
+    segs = np.split(x, cuts)
+
+    # causal FIR
+    h = rng.standard_normal(21, dtype=np.float32)
+    st = ops.fir_stream_init(h)
+    ys = []
+    for s in segs:
+        if s.size == 0:
+            continue
+        st, y = ops.fir_stream_step(st, s, h)
+        ys.append(np.asarray(y))
+    np.testing.assert_array_equal(np.concatenate(ys),
+                                  np.asarray(ops.causal_fir(x, h)))
+
+    # SWT level 2
+    d = ops.swt_stream_delay(6, 2)
+    sw = ops.swt_stream_init(6, 2)
+    his = []
+    for s in segs:
+        if s.size == 0:
+            continue
+        sw, (hi, _) = ops.swt_stream_step(sw, s, "daubechies", 6, 2)
+        his.append(np.asarray(hi))
+    want_hi, _ = ops.stationary_wavelet_apply(x, "daubechies", 6, level=2)
+    np.testing.assert_array_equal(np.concatenate(his)[d:],
+                                  np.asarray(want_hi)[:n - d])
+
+    # peaks (positions global, union exact)
+    pk = ops.peaks_stream_init()
+    got_pos = []
+    for s in segs:
+        if s.size == 0:
+            continue
+        pk, (pos, _, cnt) = ops.peaks_stream_step(pk, s,
+                                                  capacity=max(s.size, 1))
+        got_pos.extend(np.asarray(pos)[:int(cnt)].tolist())
+    wpos, _, wcnt = ops.detect_peaks_fixed(x, capacity=n - 2)
+    np.testing.assert_array_equal(np.array(got_pos),
+                                  np.asarray(wpos)[:int(wcnt)])
