@@ -241,9 +241,33 @@ def bench_stream(scale=1):
             "unit": "MSamples/s", "vs_baseline": None}
 
 
+def bench_spectral(scale=1):
+    """Batched Welch PSD (the SpectralPeakAnalyzer front half): 64
+    signals x 16384 samples, nfft=512 hop=128 — gather-free framing +
+    one batched rfft per step (ops/spectral.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu import ops
+
+    batch = 64
+    n = max(int(16384 * scale), 512)   # >= nfft: CPU smoke scale shrinks n
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+
+    def step(c):
+        p = ops.welch(c, nfft=512, hop=128)
+        return c + jnp.float32(1e-9) * jnp.sum(p)
+
+    dt = chain_time(step, x, iters=2048, null_carry=x[:1, :8])
+    return {"metric": f"welch_b{batch}_n{n}_nfft512",
+            "value": round(batch * n / dt / 1e6, 1),
+            "unit": "MSamples/s", "vs_baseline": None}
+
+
 CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
            bench_batched_pipeline, bench_flagship, bench_stream,
-           bench_feed_io)
+           bench_spectral, bench_feed_io)
 
 
 def run_secondary(stream, scale=None):
