@@ -140,7 +140,7 @@ def test_window_length_validated():
     with pytest.raises(ValueError, match="window length"):
         ops.stft(np.zeros(512, np.float32), nfft=128, window=np.ones(64))
     with pytest.raises(ValueError, match="window length"):
-        ops.istft(jnp.zeros((4, 65), jnp.complex64), nfft=128,
+        ops.istft(np.zeros((4, 65), np.complex64), nfft=128,
                   window=np.ones(64))
 
 

@@ -428,13 +428,19 @@ def test_istft_stream_realtime_masking(rng):
 
 
 def test_istft_stream_validation():
+    # host numpy arrays throughout: validation must raise without any
+    # device conversion (the axon tunnel lacks complex64 transfer and
+    # a failed transfer poisons the backend for the rest of the run)
     st = ops.istft_stream_init(128, 32)
     with pytest.raises(ValueError, match="carry length"):
-        ops.istft_stream_step(st, jnp.zeros((2, 65), jnp.complex64),
+        ops.istft_stream_step(st, np.zeros((2, 65), np.complex64),
                               nfft=128, hop=64)
     with pytest.raises(ValueError, match="window length"):
-        ops.istft_stream_step(st, jnp.zeros((2, 65), jnp.complex64),
+        ops.istft_stream_step(st, np.zeros((2, 65), np.complex64),
                               nfft=128, hop=32, window=np.ones(64))
+    with pytest.raises(ValueError, match="bins"):
+        ops.istft_stream_step(st, np.zeros((2, 257), np.complex64),
+                              nfft=128, hop=32)
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])

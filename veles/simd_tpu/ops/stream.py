@@ -413,12 +413,15 @@ def istft_stream_step(state: IstftStreamState, spec, *, nfft: int,
         raise ValueError(
             f"state carry length {state.carry.shape[-1]} != nfft - hop "
             f"= {nfft - hop}; init and step must agree on (nfft, hop)")
-    spec = jnp.asarray(spec)
-    if spec.shape[-1] != nfft // 2 + 1:
+    # validate the bin count BEFORE any device conversion: validation
+    # of a host array must not touch the device (the axon tunnel lacks
+    # complex64 transfer, and a failed transfer poisons the backend)
+    if jnp.shape(spec)[-1] != nfft // 2 + 1:
         raise ValueError(
-            f"spectrum has {spec.shape[-1]} bins, expected nfft//2+1 = "
-            f"{nfft // 2 + 1} (was the analysis run with a different "
-            f"nfft?)")
+            f"spectrum has {jnp.shape(spec)[-1]} bins, expected "
+            f"nfft//2+1 = {nfft // 2 + 1} (was the analysis run with a "
+            f"different nfft?)")
+    spec = jnp.asarray(spec)
     frames = jnp.fft.irfft(spec, n=nfft, axis=-1) * window
     _check_stream_batch(state.carry, frames[..., 0, :],
                         "istft_stream_init")
