@@ -192,3 +192,29 @@ def test_welch_matches_model_normalization(rng):
     tone = np.sin(2 * np.pi * 32.0 / 256.0 * t).astype(np.float32)
     p = np.asarray(ops.welch(tone, nfft=256, hop=64))
     assert int(p.argmax()) == 32
+
+
+@pytest.mark.parametrize("op,kw", [
+    pytest.param("stft", {}, marks=pytest.mark.native_complex),
+    ("spectrogram", {}), ("welch", {}),
+])
+def test_impl_reference_differential(rng, op, kw):
+    """The float64 oracle (reference/spectral.py) vs the jitted path —
+    the framework's three-backend contract now covers spectral too."""
+    x = rng.standard_normal((2, 1024), dtype=np.float32)
+    fn = getattr(ops, op)
+    got = np.asarray(fn(x, nfft=256, hop=64, impl="xla", **kw))
+    want = fn(x, nfft=256, hop=64, impl="reference", **kw)
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.native_complex  # fetches the complex spectrum to host
+def test_istft_impl_reference_differential(rng):
+    x = rng.standard_normal(1024, dtype=np.float32)
+    spec = np.asarray(ops.stft(x, nfft=128, hop=32))
+    got = np.asarray(ops.istft(spec, nfft=128, hop=32, impl="xla"))
+    want = ops.istft(spec, nfft=128, hop=32, impl="reference")
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # oracle also honors the zero-pad length contract
+    w = ops.istft(spec, nfft=128, hop=32, length=1200, impl="reference")
+    assert w.shape == (1200,) and np.all(w[1100:] == 0)

@@ -26,6 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.reference import spectral as _ref
+
 
 def hann_window(nfft: int, dtype=jnp.float32):
     """Periodic Hann window (the DFT-even analysis choice)."""
@@ -86,13 +89,16 @@ def _stft(x, window, nfft, hop):
     return jnp.fft.rfft(frames * window, axis=-1)
 
 
-def stft(x, *, nfft: int = 512, hop: int | None = None, window=None):
+def stft(x, *, nfft: int = 512, hop: int | None = None, window=None,
+         impl=None):
     """Short-time Fourier transform -> complex (..., n_frames, nfft//2+1).
 
     Frames start at multiples of ``hop`` (default ``nfft // 4``); only
     frames fully inside the signal are taken (no centering/padding).
     ``window`` defaults to the periodic Hann.
     """
+    if resolve_impl(impl) == "reference":
+        return _ref.stft(x, nfft=nfft, hop=hop, window=window)
     hop = nfft // 4 if hop is None else hop
     window = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
@@ -122,7 +128,7 @@ def _istft(spec, window, nfft, hop, length):
 
 
 def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
-          length: int | None = None):
+          length: int | None = None, impl=None):
     """Inverse STFT by normalized overlap-add -> (..., (F-1)*hop + nfft)
     (trimmed to ``length`` if given).
 
@@ -133,6 +139,9 @@ def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
     hop under a zero-endpoint window) come back 0. Requires
     ``nfft % hop == 0``.
     """
+    if resolve_impl(impl) == "reference":
+        return _ref.istft(spec, nfft=nfft, hop=hop, window=window,
+                          length=length)
     hop = nfft // 4 if hop is None else hop
     window = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
@@ -141,20 +150,28 @@ def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
     return _istft(spec, window, nfft, hop, length)
 
 
-def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None):
+def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None,
+                impl=None):
     """Power spectrogram |STFT|^2 -> float32 (..., n_frames, nfft//2+1)."""
-    s = stft(x, nfft=nfft, hop=hop, window=window)
+    if resolve_impl(impl) == "reference":
+        return _ref.spectrogram(x, nfft=nfft, hop=hop, window=window)
+    # the resolved choice propagates: an explicit impl= must not be
+    # overridden by the ambient switch in the inner call
+    s = stft(x, nfft=nfft, hop=hop, window=window, impl="xla")
     return (jnp.abs(s) ** 2).astype(jnp.float32)
 
 
-def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
+def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
+          impl=None):
     """Welch power spectral density -> float32 (..., nfft//2+1): the
     spectrogram averaged over frames, normalized by the window energy
     (``sum(w^2) * nfft``) — the estimator models.SpectralPeakAnalyzer
     feeds its peak extraction."""
+    if resolve_impl(impl) == "reference":
+        return _ref.welch(x, nfft=nfft, hop=hop, window=window)
     hop = nfft // 4 if hop is None else hop
     w = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
-    p = spectrogram(x, nfft=nfft, hop=hop, window=w)
+    p = spectrogram(x, nfft=nfft, hop=hop, window=w, impl="xla")
     return (jnp.mean(p, axis=-2) /
             (jnp.sum(w * w) * nfft)).astype(jnp.float32)

@@ -1,0 +1,88 @@
+"""Float64 oracle for the spectral ops (ops/spectral.py).
+
+Plain NumPy loop formulations — the `_na` twin of the short-time layer
+(framework extension; the reference's FFTs serve only convolution,
+src/convolve.c:231-326, so there is no C analogue to cite). The jitted
+TPU path is differentially tested against these in
+tests/test_spectral_ops.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def hann_window(nfft: int):
+    n = np.arange(nfft, dtype=np.float64)
+    return 0.5 - 0.5 * np.cos(2 * np.pi * n / nfft)
+
+
+def frame(x, frame_length: int, hop: int):
+    x = np.asarray(x, np.float64)
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(f"frame_length {frame_length} > signal {n}")
+    if hop < 1:
+        raise ValueError("hop must be >= 1")
+    n_frames = 1 + (n - frame_length) // hop
+    return np.stack([x[..., s * hop:s * hop + frame_length]
+                     for s in range(n_frames)], axis=-2)
+
+
+def overlap_add(frames, hop: int):
+    frames = np.asarray(frames, np.float64)
+    L, F = frames.shape[-1], frames.shape[-2]
+    if hop < 1:
+        raise ValueError("hop must be >= 1")
+    if L % hop:
+        raise ValueError(f"overlap_add needs frame_length % hop == 0, "
+                         f"got {L} % {hop}")
+    out = np.zeros(frames.shape[:-2] + ((F - 1) * hop + L,), np.float64)
+    for f in range(F):
+        out[..., f * hop:f * hop + L] += frames[..., f, :]
+    return out
+
+
+def _window(nfft, window):
+    w = hann_window(nfft) if window is None else np.asarray(window,
+                                                            np.float64)
+    if w.shape[-1] != nfft:
+        raise ValueError(f"window length {w.shape[-1]} != nfft {nfft}")
+    return w
+
+
+def stft(x, *, nfft: int = 512, hop: int | None = None, window=None):
+    hop = nfft // 4 if hop is None else hop
+    w = _window(nfft, window)
+    return np.fft.rfft(frame(x, nfft, hop) * w, axis=-1)
+
+
+def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
+          length: int | None = None):
+    hop = nfft // 4 if hop is None else hop
+    w = _window(nfft, window)
+    spec = np.asarray(spec)
+    frames = np.fft.irfft(spec, n=nfft, axis=-1) * w
+    num = overlap_add(frames, hop)
+    den = overlap_add(
+        np.broadcast_to(w * w, (spec.shape[-2], nfft)), hop)
+    out = np.where(den > 1e-12, num / np.maximum(den, 1e-12), 0.0)
+    if length is not None:
+        if length > out.shape[-1]:
+            pad = [(0, 0)] * (out.ndim - 1) + [(0, length - out.shape[-1])]
+            out = np.pad(out, pad)
+        else:
+            out = out[..., :length]
+    return out
+
+
+def spectrogram(x, *, nfft: int = 512, hop: int | None = None,
+                window=None):
+    return np.abs(stft(x, nfft=nfft, hop=hop, window=window)) ** 2
+
+
+def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
+    hop = nfft // 4 if hop is None else hop
+    w = _window(nfft, window)
+    p = spectrogram(x, nfft=nfft, hop=hop, window=w)
+    return p.mean(axis=-2) / (np.sum(w * w) * nfft)
