@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Live denoising demo: producer thread -> ingestion ring -> streaming
+multi-level wavelet shrinkage, with fixed 49-sample latency.
+
+    python examples/realtime_denoise.py
+
+A producer pushes ragged int16 "ADC packets" into the native ring
+buffer; the consumer pops hop-aligned chunks and runs the streaming
+denoiser. The output equals the whole-signal shrinkage pipeline exactly
+(past warm-up) while never holding more than one chunk in flight.
+"""
+
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon TPU plugin overrides the env var at import time; the
+        # config update after import is authoritative (see tests/conftest)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from veles.simd_tpu.host.ring import RingBuffer
+    from veles.simd_tpu.models import StreamingWaveletDenoiser
+
+    fs, n, chunk = 16000.0, 65536, 2048
+    t = np.arange(n) / fs
+    rng = np.random.default_rng(7)
+    clean = np.sin(2 * np.pi * 220.0 * t).astype(np.float32)
+    scale = 8192.0
+    noisy_i16 = np.clip((clean + 0.4 * rng.normal(size=n)) * scale,
+                        -32768, 32767).astype(np.int16)
+
+    ring = RingBuffer(chunk_len=chunk, capacity=1 << 15)
+
+    def produce():                       # ragged packets, like a driver
+        g, i = np.random.default_rng(1), 0
+        while i < n:
+            k = min(int(g.integers(64, 4000)), n - i)
+            sent = 0
+            while sent < k:              # retry: this demo must not drop
+                got = ring.push(noisy_i16[i + sent:i + k])
+                sent += got
+                if not got:              # full: yield to the consumer
+                    time.sleep(0.002)
+            i += k
+        ring.close()
+
+    den = StreamingWaveletDenoiser("daubechies", 8, levels=3,
+                                   thresholds=1.0 * scale)
+    state = den.init()
+    threading.Thread(target=produce, daemon=True).start()
+
+    outs = []
+    for c in ring:                       # int16 converted natively on push
+        state, y = den.step(state, c)
+        outs.append(np.asarray(y))
+    y = np.concatenate(outs) / scale
+    s = den.latency
+
+    noisy = noisy_i16.astype(np.float32) / scale
+
+    def snr(sig, ref):
+        return 10 * np.log10((ref ** 2).sum() / ((sig - ref) ** 2).sum())
+
+    print(f"latency: {s} samples ({1000 * s / fs:.2f} ms at {fs:.0f} Hz)")
+    print(f"SNR: {snr(noisy[s:n - s], clean[s:n - s]):5.1f} dB in -> "
+          f"{snr(y[2 * s:], clean[s:n - s]):5.1f} dB out")
+    # .dropped counts rejected offers; this producer retries, so loss is
+    # measured by what actually came through
+    print(f"samples processed: {y.size}/{n} (no loss)"
+          if y.size == n else f"SAMPLES LOST: {n - y.size}")
+    ring.destroy()
+
+
+if __name__ == "__main__":
+    main()

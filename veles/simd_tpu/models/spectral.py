@@ -27,7 +27,8 @@ def _analyze(signals, window, nfft, hop, capacity):
     x = jnp.asarray(signals, jnp.float32)
     # shared short-time analysis (ops/spectral.py): Welch-averaged
     # normalized power through the gather-free framing path
-    power = ops.welch(x, nfft=nfft, hop=hop, window=window)
+    power = ops.welch(x, nfft=nfft, hop=hop, window=window,
+                      impl="xla")  # jitted trace: pin like detect_peaks_topk
 
     logp = jnp.log(power + jnp.float32(1e-20))
     positions, values, count = ops.detect_peaks_topk(

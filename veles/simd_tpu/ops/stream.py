@@ -366,7 +366,9 @@ def stft_stream_step(state: StftStreamState, chunk, *, nfft: int,
             f"= {nfft - hop}; init and step must agree on (nfft, hop)")
     _check_stream_batch(state.carry, chunk, "stft_stream_init")
     z = jnp.concatenate([state.carry, chunk], axis=-1)
-    spec = spectral.stft(z, nfft=nfft, hop=hop, window=window)
+    # jitted trace: the NumPy oracle cannot run on tracers
+    spec = spectral.stft(z, nfft=nfft, hop=hop, window=window,
+                         impl="xla")
     return StftStreamState(z[..., z.shape[-1] - (nfft - hop):]), spec
 
 

@@ -256,7 +256,7 @@ def bench_spectral(scale=1):
     x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
 
     def step(c):
-        p = ops.welch(c, nfft=512, hop=128)
+        p = ops.welch(c, nfft=512, hop=128, impl="xla")
         return c + jnp.float32(1e-9) * jnp.sum(p)
 
     dt = chain_time(step, x, iters=2048, null_carry=x[:1, :8])
