@@ -178,3 +178,79 @@ class TestWaveletPackets:
             ops.wavelet_packet_decompose(np.zeros(100, np.float32), 3)
         with pytest.raises(ValueError, match="2\\^levels"):
             ops.wavelet_packet_reconstruct(np.zeros((3, 16), np.float32))
+
+
+class TestBestBasis:
+    """Coifman-Wickerhauser best basis over the packet tree."""
+
+    def _all_bases(self, levels):
+        # every admissible pruning of a depth-`levels` binary tree
+        def expand(lv, i):
+            if lv == levels:
+                return [[(lv, i)]]
+            keep = [[(lv, i)]]
+            for left in expand(lv + 1, 2 * i):
+                for right in expand(lv + 1, 2 * i + 1):
+                    keep.append(left + right)
+            return keep
+        return expand(0, 0)
+
+    def test_dp_is_globally_optimal(self, rng):
+        """The DP result matches brute force over all 26 admissible
+        depth-3 bases."""
+        x = rng.standard_normal(256).astype(np.float32)
+        levels = 3
+        basis, coeffs, total = ops.wavelet_packet_best_basis(
+            x, levels, "daubechies", 4)
+        tree = ops.wavelet_packet_tree(x, levels, "daubechies", 4)
+        node = {(0, 0): np.asarray(x, np.float64)}
+        for lv in range(1, levels + 1):
+            for i in range(1 << lv):
+                node[(lv, i)] = np.asarray(tree[lv - 1][i], np.float64)
+        candidates = self._all_bases(levels)
+        assert len(candidates) == 26
+        brute = min(sum(ops.shannon_cost(node[nd]) for nd in b)
+                    for b in candidates)
+        np.testing.assert_allclose(total, brute, rtol=1e-12)
+
+    def test_tone_prefers_deep_frequency_splits(self):
+        """A pure tone concentrates in frequency: the best basis should
+        be strictly cheaper than the no-split basis."""
+        t = np.arange(512, dtype=np.float32)
+        x = np.sin(2 * np.pi * 31.0 / 512.0 * t)
+        basis, _, total = ops.wavelet_packet_best_basis(x, 4)
+        assert total < ops.shannon_cost(x)
+        assert any(lv > 0 for lv, _ in basis)
+
+    def test_reconstruct_from_best_basis(self, rng):
+        x = rng.standard_normal(512).astype(np.float32)
+        basis, coeffs, _ = ops.wavelet_packet_best_basis(
+            x, 3, "daubechies", 8)
+        y = np.asarray(ops.wavelet_packet_reconstruct_basis(
+            coeffs, "daubechies", 8))
+        np.testing.assert_allclose(y, x, atol=2e-4)
+
+    def test_reconstruct_any_admissible_basis(self, rng):
+        """Perfect reconstruction holds for every admissible pruning,
+        not just the optimal one."""
+        x = rng.standard_normal(256).astype(np.float32)
+        tree = ops.wavelet_packet_tree(x, 3, "daubechies", 4)
+        node = {}
+        for lv in range(1, 4):
+            for i in range(1 << lv):
+                node[(lv, i)] = np.asarray(tree[lv - 1][i])
+        for basis in self._all_bases(3)[1:6]:   # a handful, skip root
+            coeffs = {nd: node[nd] for nd in basis}
+            y = np.asarray(ops.wavelet_packet_reconstruct_basis(
+                coeffs, "daubechies", 4))
+            np.testing.assert_allclose(y, x, atol=2e-4)
+
+    def test_validation(self, rng):
+        with pytest.raises(ValueError, match="1-D"):
+            ops.wavelet_packet_best_basis(
+                np.zeros((2, 64), np.float32), 2)
+        with pytest.raises(ValueError, match="sibling"):
+            ops.wavelet_packet_reconstruct_basis(
+                {(1, 0): np.zeros(32, np.float32)})
+        with pytest.raises(ValueError, match="empty"):
+            ops.wavelet_packet_reconstruct_basis({})

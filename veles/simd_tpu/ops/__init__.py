@@ -27,8 +27,10 @@ from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_CONSTANT, EXTENSION_MIRROR, EXTENSION_PERIODIC, EXTENSION_TYPES,
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,
     stationary_wavelet_recompose, stationary_wavelet_reconstruct,
-    wavelet_allocate_destination, wavelet_apply, wavelet_decompose,
+    shannon_cost, wavelet_allocate_destination, wavelet_apply,
+    wavelet_decompose, wavelet_packet_best_basis,
     wavelet_packet_decompose, wavelet_packet_reconstruct,
+    wavelet_packet_reconstruct_basis, wavelet_packet_tree,
     wavelet_prepare_array, wavelet_recompose, wavelet_reconstruct,
     wavelet_recycle_source, wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401

@@ -471,6 +471,128 @@ def wavelet_packet_reconstruct(bands, wavelet_type="daubechies", order=8,
     return bands[..., 0, :]
 
 
+def wavelet_packet_tree(src, levels, wavelet_type="daubechies", order=8,
+                        ext=EXTENSION_PERIODIC, *, impl=None):
+    """Every node of the packet tree -> list of ``levels`` arrays,
+    entry l-1 holding level l's ``(..., 2^l, n/2^l)`` bands (natural
+    order). Level ``levels`` equals ``wavelet_packet_decompose``; the
+    shallower levels are the intermediate nodes best-basis selection
+    chooses among."""
+    impl = resolve_impl(impl)
+    x = np.asarray(src, np.float64) if impl == "reference" \
+        else jnp.asarray(src, jnp.float32)
+    n = x.shape[-1]
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    if n % (1 << levels) != 0:
+        raise ValueError(
+            f"length {n} must be divisible by 2^levels = {1 << levels}")
+    xp = np if impl == "reference" else jnp
+    apply = (functools.partial(_ref.wavelet_apply, wavelet_type=wavelet_type,
+                               order=order, ext=ext)
+             if impl == "reference" else
+             lambda b: wavelet_apply(b, wavelet_type, order, ext, impl=impl))
+    bands = x[..., None, :]
+    tree = []
+    for _ in range(levels):
+        hi, lo = apply(bands)
+        bands = xp.stack([lo, hi], axis=-2)
+        bands = bands.reshape(*bands.shape[:-3], -1, bands.shape[-1])
+        tree.append(bands)
+    return tree
+
+
+def shannon_cost(coeffs) -> float:
+    """Additive Shannon-entropy cost -sum(c^2 * log(c^2)) of a
+    coefficient array (the Coifman–Wickerhauser information cost;
+    lower = sparser)."""
+    c2 = np.asarray(coeffs, np.float64).ravel() ** 2
+    c2 = c2[c2 > 0]
+    return float(-(c2 * np.log(c2)).sum())
+
+
+def wavelet_packet_best_basis(src, levels, wavelet_type="daubechies",
+                              order=8, ext=EXTENSION_PERIODIC, *,
+                              cost=shannon_cost, impl=None):
+    """Coifman–Wickerhauser best-basis search over the full packet tree
+    -> ``(basis, coeffs, total_cost)`` for a single signal.
+
+    ``basis`` is a list of ``(level, index)`` terminal nodes partitioning
+    the time-frequency plane; ``coeffs`` maps each node to its
+    coefficient array; ``total_cost`` is the additive ``cost`` summed
+    over the basis — minimal over ALL admissible prunings by bottom-up
+    dynamic programming (each parent keeps itself iff its cost does not
+    exceed its children's best total).
+
+    Host-side selection on concrete arrays (the structure is
+    data-dependent — the same host/device split as detect_peaks'
+    dynamic trim, SURVEY §7 hard part (a)); the per-node transforms run
+    on-device through the packet tree.
+    """
+    x = np.asarray(src)
+    if x.ndim != 1:
+        raise ValueError("best-basis selection is per-signal (1-D)")
+    tree = wavelet_packet_tree(x, levels, wavelet_type, order, ext,
+                               impl=impl)
+    node = {(0, 0): np.asarray(x, np.float64)}
+    for lv in range(1, levels + 1):
+        arr = np.asarray(tree[lv - 1], np.float64)
+        for i in range(1 << lv):
+            node[(lv, i)] = arr[i]
+
+    best_cost = {}
+    best_nodes = {}
+    for i in range(1 << levels):
+        best_cost[(levels, i)] = cost(node[(levels, i)])
+        best_nodes[(levels, i)] = [(levels, i)]
+    for lv in range(levels - 1, -1, -1):
+        for i in range(1 << lv):
+            own = cost(node[(lv, i)])
+            kids = best_cost[(lv + 1, 2 * i)] + best_cost[(lv + 1, 2 * i + 1)]
+            if own <= kids:
+                best_cost[(lv, i)] = own
+                best_nodes[(lv, i)] = [(lv, i)]
+            else:
+                best_cost[(lv, i)] = kids
+                best_nodes[(lv, i)] = (best_nodes[(lv + 1, 2 * i)]
+                                       + best_nodes[(lv + 1, 2 * i + 1)])
+    basis = best_nodes[(0, 0)]
+    coeffs = {nd: node[nd] for nd in basis}
+    return basis, coeffs, best_cost[(0, 0)]
+
+
+def wavelet_packet_reconstruct_basis(coeffs, wavelet_type="daubechies",
+                                     order=8, ext=EXTENSION_PERIODIC, *,
+                                     impl=None):
+    """Rebuild the signal from any admissible basis ``{(level, index):
+    band}`` (e.g. best-basis output, possibly thresholded): sibling
+    pairs fold upward with ``wavelet_reconstruct`` until the root."""
+    work = {nd: v for nd, v in coeffs.items()}
+    if not work:
+        raise ValueError("empty basis")
+    while len(work) > 1 or (0, 0) not in work:
+        deepest = max(lv for lv, _ in work)
+        merged = {}
+        taken = set()
+        for (lv, i) in sorted(work):
+            if lv != deepest or (lv, i) in taken:
+                continue
+            sib = (lv, i ^ 1)
+            if sib not in work:
+                raise ValueError(
+                    f"basis is not admissible: node {(lv, i)} has no "
+                    f"sibling {sib}")
+            taken.add((lv, i))
+            taken.add(sib)
+            lo, hi = (work[(lv, i)], work[sib]) if i % 2 == 0 else \
+                (work[sib], work[(lv, i)])
+            merged[(lv - 1, i // 2)] = wavelet_reconstruct(
+                hi, lo, wavelet_type, order, ext, impl=impl)
+        work = {nd: v for nd, v in work.items() if nd not in taken}
+        work.update(merged)
+    return work[(0, 0)]
+
+
 # ---------------------------------------------------------------------------
 # buffer-protocol parity shims (layout is XLA's job; shapes preserved)
 # ---------------------------------------------------------------------------
