@@ -2,32 +2,51 @@
 """Benchmark harness. Prints ONE JSON line for the driver.
 
 Headline metric (BASELINE.md): matrix_multiply float32 N=4096 on one chip,
-reported as achieved GFLOPS. ``vs_baseline`` is the ratio against the
-north-star target of 50% MXU utilization at the v5e bf16 peak
-(0.5 * 197 TFLOPS = 98.5 TFLOPS); >= 1.0 means the target is met.
+reported as achieved GFLOPS (both impl="xla" dot_general and the hand
+Pallas kernel; the headline value is the xla path). ``vs_baseline`` is the
+ratio against the north-star target of 50% MXU utilization at the v5e bf16
+peak (0.5 * 197 TFLOPS = 98.5 TFLOPS); >= 1.0 means the target is met.
+
+All BASELINE secondary configs (elementwise, convolve, DWT,
+normalize+peaks, flagship pipeline, streaming, Welch, feed IO) land in the
+same stdout JSON under ``configs``; chain-timed configs carry both the
+floor-corrected ``value`` and the uncorrected wall-clock ``raw_value``
+lower bound (feed_io is host-wall-clocked, so its single value is already
+raw).
+
+Resilience contract (the round-1 failure mode was a transient
+``UNAVAILABLE: TPU backend setup/compile error`` crashing the whole run):
+the measurement runs in a worker subprocess; the supervisor retries backend
+bring-up failures with backoff (full run twice, then a headline-only
+attempt), and on persistent failure still prints ONE JSON line with an
+``error`` field — the driver always gets parseable output.
 
 Measurement method: utils/benchlib.py — the op is iterated inside one jit'd
 lax.scan with a data dependency between steps, and a null chain's total is
 subtracted (the axon tunnel defers execution past block_until_ready and
 adds a ~70 ms round trip, so per-dispatch wall-clocking measures nothing).
-
-``python bench.py --all`` additionally reports the secondary BASELINE
-configs on stderr as they come online.
+The headline corrected GFLOPS carries a sanity clamp: a value above the
+chip's bf16 peak is reported clamped to peak with ``clamped: true`` (the
+paired floor can over-correct when the tunnel drifts mid-rep).
 """
 
 import argparse
 import json
+import math
+import os
+import subprocess
 import sys
-
-import numpy as np
+import time
 
 V5E_BF16_PEAK_GFLOPS = 197_000.0
 TARGET_GFLOPS = 0.5 * V5E_BF16_PEAK_GFLOPS
+HEADLINE_METRIC = "matrix_multiply_f32_n4096"
 
 
 def bench_matmul_4096():
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     on_tpu = jax.default_backend() == "tpu"
     n = 4096 if on_tpu else 256  # CPU smoke fallback; driver runs on TPU
@@ -37,43 +56,149 @@ def bench_matmul_4096():
     b = jax.random.normal(k2, (n, n), jnp.float32) / jnp.float32(np.sqrt(n))
 
     from veles.simd_tpu import ops
-    from veles.simd_tpu.utils.benchlib import chain_time
+    from veles.simd_tpu.utils.benchlib import chain_stats
 
     # Chip capability drifts ~2x run-to-run on the shared tunnel; three
     # spaced attempt groups (compiled once, best paired-floor difference)
     # make the report repeatable to ~4%. Tiny null carry: the floor must
     # capture only dispatch/scan/RTT overhead — a full-size null chain
     # would also cancel the HBM pass the matmul legitimately pays,
-    # inflating GFLOPS past peak.
-    best_dt = chain_time(
-        lambda c: ops.matrix_multiply(c, b), a, iters, reps=3,
-        null_carry=a[:8, :8], attempts=3 if on_tpu else 1,
-        attempt_gap_s=2.0)
-    gflops = 2 * n ** 3 / best_dt / 1e9
-    return {
+    # inflating GFLOPS past peak. Both MXU impls run interleaved in the
+    # same process so their numbers share one floor and are comparable.
+    steps = {"xla": lambda c: ops.matrix_multiply(c, b),
+             "pallas": lambda c: ops.matrix_multiply(c, b, impl="pallas")}
+    sts = chain_stats(steps, a, iters, reps=3, on_floor="nan",
+                      null_carry=a[:8, :8], attempts=3 if on_tpu else 1,
+                      attempt_gap_s=2.0)
+
+    def gflops(sec):
+        if sec is None or not math.isfinite(sec) or sec <= 0:
+            return None
+        return round(2 * n ** 3 / sec / 1e9, 1)
+
+    xla_g = gflops(sts["xla"]["sec"])
+    raw_g = gflops(sts["xla"]["raw_sec"])
+    clamped = xla_g is not None and xla_g > V5E_BF16_PEAK_GFLOPS
+    value = min(xla_g, V5E_BF16_PEAK_GFLOPS) if clamped else xla_g
+    pallas_g = gflops(sts["pallas"]["sec"])
+    result = {
         "metric": f"matrix_multiply_f32_n{n}",
-        "value": round(gflops, 1),
+        "value": value,
         "unit": "GFLOPS",
-        "vs_baseline": round(gflops / TARGET_GFLOPS, 4),
+        "vs_baseline": (round(value / TARGET_GFLOPS, 4)
+                        if value is not None else None),
+        "raw_value": raw_g,
+        "clamped": clamped,
+        "pallas_gflops": pallas_g,
+        "pallas_raw_gflops": gflops(sts["pallas"]["raw_sec"]),
     }
+    if xla_g and pallas_g:
+        result["pallas_vs_xla"] = round(pallas_g / xla_g, 3)
+    return result
+
+
+def worker_main(headline_only: bool) -> int:
+    import jax
+
+    # The axon TPU plugin on this box overrides JAX_PLATFORMS at import
+    # time; a config update after import is the authoritative way to
+    # force CPU (for smoke runs / CI boxes without the tunnel).
+    if (os.environ.get("VELES_BENCH_CPU") == "1"
+            or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()  # forces backend bring-up first
+    result = bench_matmul_4096()
+    if not headline_only:
+        from veles.simd_tpu.utils.bench_extra import collect_secondary
+        result["configs"] = collect_secondary(progress=sys.stderr)
+    result["backend"] = backend
+    print(json.dumps(result))
+    return 0
+
+
+def _parse_worker_json(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def supervise(headline_only_run: bool = False) -> int:
+    """Run the worker with retry/backoff; always print one JSON line.
+
+    Failure taxonomy from round 1: the tunnel either fails FAST
+    (``UNAVAILABLE`` at backend init — worth retrying with backoff) or
+    HANGS (bring-up blocks indefinitely — a second full-length attempt
+    would just burn the driver's budget, so a hang skips straight to one
+    short headline-only try before giving up)."""
+    if headline_only_run:
+        plans = [(True, 600, 0), (True, 600, 10), (True, 600, 30)]
+    else:
+        plans = [  # (headline_only, timeout_s, sleep_before_s)
+            (False, 1200, 0),
+            (False, 1200, 10),
+            (True, 480, 30),
+        ]
+    last_err = "no attempts ran"
+    hung = False
+    for headline_only, timeout_s, sleep_s in plans:
+        if hung and not headline_only:
+            continue  # tunnel hangs: don't repeat a full-length attempt
+        if sleep_s:
+            time.sleep(sleep_s)
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+        if headline_only:
+            cmd.append("--headline-only")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+        except subprocess.TimeoutExpired as e:
+            hung = True
+            last_err = f"worker timed out after {timeout_s}s"
+            tail = (e.stderr or b"")
+            if isinstance(tail, bytes):
+                tail = tail.decode("utf-8", "replace")
+            if tail:
+                last_err += f"; stderr tail: {tail[-800:]}"
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        result = _parse_worker_json(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            if headline_only and not headline_only_run:
+                result["note"] = ("secondary configs skipped: earlier full "
+                                  "attempts failed or hung; headline-only "
+                                  "fallback")
+            print(json.dumps(result))
+            return 0
+        last_err = (f"worker rc={proc.returncode}; "
+                    f"stderr tail: {proc.stderr[-1200:]}")
+    # Persistent failure: still emit one parseable line for the driver.
+    print(json.dumps({
+        "metric": HEADLINE_METRIC, "value": None, "unit": "GFLOPS",
+        "vs_baseline": None, "error": last_err[-2000:],
+    }))
+    return 0
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run the measurement in-process")
+    ap.add_argument("--headline-only", action="store_true",
+                    help="skip the secondary configs")
     ap.add_argument("--all", action="store_true",
-                    help="also run secondary configs (reported on stderr)")
+                    help="deprecated (secondary configs now run by "
+                         "default); kept for compatibility")
     args = ap.parse_args()
 
-    result = bench_matmul_4096()
-
-    if args.all:
-        try:
-            from veles.simd_tpu.utils.bench_extra import run_secondary
-            run_secondary(sys.stderr)
-        except ImportError:
-            print("secondary configs not yet available", file=sys.stderr)
-
-    print(json.dumps(result))
+    if args.worker:
+        sys.exit(worker_main(args.headline_only))
+    sys.exit(supervise(headline_only_run=args.headline_only))
 
 
 if __name__ == "__main__":

@@ -168,6 +168,48 @@ class TestBatch:
         np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
                                    atol=1e-5)
 
+    def test_batched_pallas_swt(self, rng):
+        """(B, N) rides the kernel's batch grid dim, not an outer vmap."""
+        batch = rng.normal(size=(6, 96)).astype(np.float32)
+        hi_x, lo_x = W.stationary_wavelet_apply(batch, "daubechies", 8, 2,
+                                                "periodic", impl="xla")
+        hi_p, lo_p = W.stationary_wavelet_apply(batch, "daubechies", 8, 2,
+                                                "periodic", impl="pallas")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
+                                   atol=1e-5)
+
+
+class TestPallasScale:
+    """Gridded kernels must handle signals far beyond one VMEM block
+    (round-1 kernels launched one grid-less block, capping N at ~16 MB;
+    reference analogue: the order-specialized streaming kernels of
+    src/wavelet.c:1042-1124 have no length cap)."""
+
+    def test_dwt_4m(self, rng):
+        n = 4 * 1024 * 1024
+        src = rng.normal(size=n).astype(np.float32)
+        hi_x, lo_x = W.wavelet_apply(src, "daubechies", 8, impl="xla")
+        hi_p, lo_p = W.wavelet_apply(src, "daubechies", 8, impl="pallas")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
+                                   atol=5e-4)
+
+    def test_swt_batched_multiblock(self, rng):
+        # (B, N) big enough that the out axis spans multiple grid blocks
+        # even at the 256k-element VMEM tile
+        batch = rng.normal(size=(16, 131072)).astype(np.float32)
+        hi_x, lo_x = W.stationary_wavelet_apply(batch, "daubechies", 8, 3,
+                                                "periodic", impl="xla")
+        hi_p, lo_p = W.stationary_wavelet_apply(batch, "daubechies", 8, 3,
+                                                "periodic", impl="pallas")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
+                                   atol=5e-4)
+
 
 class TestCascade:
     def test_dwt_decompose(self, rng):

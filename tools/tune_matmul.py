@@ -1,0 +1,80 @@
+"""Sweep Pallas matmul tile configs against XLA dot_general on-chip.
+
+The hand kernel exists to own the MXU schedule for the BASELINE north star
+(matrix_multiply N=4096, >= 50% MXU utilization); this sweep keeps it
+honest against XLA's own tiling. All candidates run interleaved in one
+process through utils/benchlib.py chained scans (see tune_convolve.py for
+why anything less lies on the tunneled chip).
+
+Swept axes: tile shape (bm, bn, bk), boundary bf16 streaming on/off.
+The winner's numbers belong in pallas/matmul.py's defaults + docstring.
+
+Run on a TPU host:  python tools/tune_matmul.py [N]
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.pallas.matmul import matmul
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    on_tpu = jax.default_backend() == "tpu"
+    iters = 512 if on_tpu else 4
+    print("backend:", jax.default_backend(), " N =", n)
+
+    rng = np.random.default_rng(0)
+    a = jax.device_put(rng.normal(size=(n, n)).astype(np.float32))
+    b = jax.device_put(
+        (rng.normal(size=(n, n)) / np.sqrt(n)).astype(np.float32))
+
+    tiles = [
+        (512, 1024, 512),
+        (512, 512, 1024),
+        (1024, 1024, 512),
+        (512, 2048, 512),
+        (1024, 512, 1024),
+        (256, 1024, 1024),
+        (512, 1024, 1024),
+        (1024, 1024, 1024),
+        (2048, 1024, 512),
+    ]
+    steps = {"xla": lambda c: jax.lax.dot_general(
+        c, b, (((1,), (0,)), ((), ())))}
+    for bm, bn, bk in tiles:
+        if bm > n or bn > n or bk > n:
+            continue
+        for stream in (True, False):
+            name = f"p{bm}x{bn}x{bk}{'_bf16io' if stream else ''}"
+            steps[name] = (lambda c, bm=bm, bn=bn, bk=bk, s=stream:
+                           matmul(c, b, bm=bm, bn=bn, bk=bk, stream_bf16=s))
+
+    compiled = {}
+    for name, fn in steps.items():
+        try:  # over-budget VMEM configs fail at compile: drop, keep going
+            jax.block_until_ready(fn(a))
+            compiled[name] = fn
+        except Exception as e:
+            print(f"{name:>24}  FAILED: {str(e).splitlines()[0][:90]}")
+
+    sts = chain_stats(compiled, a, iters, reps=3, on_floor="nan",
+                      null_carry=a[:8, :8],
+                      attempts=3 if on_tpu else 1, attempt_gap_s=2.0)
+    flops = 2 * n**3
+    xla_g = flops / sts["xla"]["sec"] / 1e9
+    print(f"{'config':>24} {'TFLOPS':>8} {'raw':>8} {'vs xla':>7}")
+    for name, st in sorted(sts.items(), key=lambda kv: kv[1]["sec"]):
+        g = flops / st["sec"] / 1e9
+        graw = flops / st["raw_sec"] / 1e9
+        print(f"{name:>24} {g / 1e3:8.1f} {graw / 1e3:8.1f} "
+              f"{g / xla_g:7.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -171,11 +171,8 @@ def wavelet_apply(src, wavelet_type="daubechies", order=8,
     hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order, np.float32)
     if impl == "pallas":
         from veles.simd_tpu.pallas.wavelet import dwt_filter_bank
-        x = _extend(src, order, ext)
-        fn = functools.partial(dwt_filter_bank, hi_taps=hi, lo_taps=lo)
-        for _ in range(src.ndim - 1):
-            fn = jax.vmap(fn)
-        return fn(x)
+        # batch-native: leading dims become a kernel grid dimension
+        return dwt_filter_bank(_extend(src, order, ext), hi, lo)
     filters = jnp.asarray(np.stack([hi, lo]))
     return _wavelet_apply_xla(src, filters, ext)
 
@@ -199,13 +196,9 @@ def stationary_wavelet_apply(src, wavelet_type="daubechies", order=8, level=1,
     hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order, np.float32)
     if impl == "pallas":
         from veles.simd_tpu.pallas.wavelet import swt_filter_bank
-        x = _extend(src, order * stride, ext)
-        n = src.shape[-1]
-        fn = functools.partial(swt_filter_bank, hi_taps=hi, lo_taps=lo,
-                               stride=stride, out_length=n)
-        for _ in range(src.ndim - 1):
-            fn = jax.vmap(fn)
-        return fn(x)
+        # batch-native: leading dims become a kernel grid dimension
+        return swt_filter_bank(_extend(src, order * stride, ext), hi, lo,
+                               stride, src.shape[-1])
     filters = jnp.asarray(np.stack([hi, lo]))
     return _stationary_apply_xla(src, filters, stride, ext)
 

@@ -43,9 +43,14 @@ def _make_kernel(transpose_b):
         def _init():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
+        # Explicit bf16 operands: a float32 dot inside Mosaic lowers to a
+        # multi-pass product (~half rate); casting the blocks keeps the
+        # MXU in its native single-pass bf16-product/f32-accumulate mode
+        # — the same operating point as XLA's DEFAULT precision. Blocks
+        # arriving as bf16 (boundary-cast path) pass through unchanged.
         acc_ref[:] += jax.lax.dot_general(
-            x_ref[:], y_ref[:], contract,
-            preferred_element_type=jnp.float32)
+            x_ref[:].astype(jnp.bfloat16), y_ref[:].astype(jnp.bfloat16),
+            contract, preferred_element_type=jnp.float32)
 
         @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
         def _flush():
@@ -68,10 +73,19 @@ def _pad_dim(a, axis, mult):
     return jnp.pad(a, widths)
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "transpose_b"))
-def _matmul_padded(x, y, bm, bn, bk, transpose_b=False):
+@functools.partial(jax.jit, static_argnames=(
+    "bm", "bn", "bk", "transpose_b", "stream_bf16"))
+def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True):
     m, k = x.shape
     n = y.shape[0] if transpose_b else y.shape[1]
+    out_dtype = x.dtype
+    if stream_bf16 and x.dtype == jnp.float32:
+        # Boundary cast: blocks travel HBM->VMEM at half width, doubling
+        # effective tile bandwidth; numerics are unchanged (the kernel
+        # multiplies in bf16 either way, accumulating f32). The cast of a
+        # loop-invariant operand hoists out of any enclosing scan.
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
     grid = (m // bm, n // bn, k // bk)
     if transpose_b:
         y_spec = pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk))
@@ -82,7 +96,7 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False):
         grid=grid,
         in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)), y_spec],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -90,13 +104,16 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False):
     )(x, y)
 
 
-def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512):
+def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512,
+           stream_bf16=True):
     """x @ y (or x @ y.T) via the tiled Pallas kernel; shapes zero-padded.
 
-    Default tiles measured best on v5e at N=4096 (within-run sweep,
-    2026-07-30): (512, 1024, 512) = 87.5 TFLOPS vs 71.2 for 512^3; tiles
-    must satisfy (bm*bk + bk*bn)*2 + bm*bn*2 f32 <= the 16 MB scoped
-    VMEM budget or the kernel fails to allocate its double buffers."""
+    float32 inputs run the MXU's native bf16-product/f32-accumulation
+    mode; ``stream_bf16`` additionally casts at the pallas_call boundary
+    so HBM->VMEM block traffic is half-width. Tiles must satisfy
+    (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the ~16 MB
+    scoped VMEM budget including double buffers, or the kernel fails to
+    allocate. Defaults from the on-chip sweep (tools/tune_matmul.py)."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     inner = y.shape[-1] if transpose_b else y.shape[0]
@@ -115,7 +132,8 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512):
         yp = _pad_dim(_pad_dim(y, 0, bn_), 1, bk_)
     else:
         yp = _pad_dim(_pad_dim(y, 0, bk_), 1, bn_)
-    out = _matmul_padded(xp, yp, bm_, bn_, bk_, transpose_b)
+    out = _matmul_padded(xp, yp, bm_, bn_, bk_, transpose_b,
+                         stream_bf16=stream_bf16)
     return out[:m, :n]
 
 

@@ -20,7 +20,18 @@ from __future__ import annotations
 
 import json
 
-from veles.simd_tpu.utils.benchlib import chain_time
+from veles.simd_tpu.utils.benchlib import chain_stat, chain_stats
+
+
+def _msps(st: dict, samples: int, digits: int = 1) -> dict:
+    """MSamples/s from a chain_stat record: corrected + raw lower bound.
+
+    ``value`` is the paired-floor-corrected rate, ``raw_value`` the
+    uncorrected wall-clock rate (always <= value; the unimpeachable
+    bound when tunnel-floor drift makes the correction suspect)."""
+    return {"value": round(samples / st["sec"] / 1e6, digits),
+            "raw_value": round(samples / st["raw_sec"] / 1e6, digits),
+            "unit": "MSamples/s", "vs_baseline": None}
 
 
 def bench_elementwise(scale=1):
@@ -42,10 +53,12 @@ def bench_elementwise(scale=1):
     # XLA keeps the 4 MB loop carry VMEM-resident across scan steps, so
     # this is on-chip VPU elementwise throughput (the right analogue of
     # the reference's in-cache arithmetic-inl.h kernels).
-    dt = chain_time(step, x, iters=8192, null_carry=x[:8])
-    gbps = n * 8 / dt / 1e9  # read + write, 4 B each
+    st = chain_stat(step, x, iters=8192, null_carry=x[:8])
+    gbps = n * 8 / st["sec"] / 1e9  # read + write, 4 B each
     return {"metric": f"elementwise_add_mul_scale_n{n}",
-            "value": round(n * 3 / dt / 1e9, 2), "unit": "Gop/s",
+            "value": round(n * 3 / st["sec"] / 1e9, 2),
+            "raw_value": round(n * 3 / st["raw_sec"] / 1e9, 2),
+            "unit": "Gop/s",
             "vs_baseline": None, "effective_gbps": round(gbps, 1)}
 
 
@@ -56,7 +69,6 @@ def bench_convolve(scale=1):
     from veles.simd_tpu.ops.convolve import (_convolve_direct_xla,
                                              _convolve_overlap_save_xla,
                                              os_block_length)
-    from veles.simd_tpu.utils.benchlib import chain_times
 
     n, m = int(65536 * scale), 127
     rng = np.random.default_rng(0)
@@ -74,13 +86,11 @@ def bench_convolve(scale=1):
         # what the auto-selector actually picks for h=127 (shift-add)
         return _convolve_direct_xla(c, h)[:n]
 
-    dts = chain_times({"os": step_os, "direct": step_direct}, x, iters=1024)
-    best = min(dts.values())
-    return {"metric": f"convolve_n{n}_m{m}",
-            "value": round(n / best / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None,
-            "overlap_save_msps": round(n / dts["os"] / 1e6, 1),
-            "direct_shift_msps": round(n / dts["direct"] / 1e6, 1)}
+    sts = chain_stats({"os": step_os, "direct": step_direct}, x, iters=1024)
+    best = min(sts.values(), key=lambda s: s["sec"])
+    return {"metric": f"convolve_n{n}_m{m}", **_msps(best, n),
+            "overlap_save_msps": round(n / sts["os"]["sec"] / 1e6, 1),
+            "direct_shift_msps": round(n / sts["direct"]["sec"] / 1e6, 1)}
 
 
 def bench_dwt(scale=1):
@@ -109,10 +119,8 @@ def bench_dwt(scale=1):
 
     # the polyphase DWT runs ~70 us/transform; thousands of chained steps
     # are needed for device time to dominate the ~100 ms tunnel RTT floor
-    dt = chain_time(six_level, x, iters=4096)
-    return {"metric": f"dwt_db8_6level_n{n}",
-            "value": round(n / dt / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None}
+    st = chain_stat(six_level, x, iters=4096)
+    return {"metric": f"dwt_db8_6level_n{n}", **_msps(st, n)}
 
 
 def bench_batched_pipeline(scale=1):
@@ -131,10 +139,9 @@ def bench_batched_pipeline(scale=1):
         _, vals, _ = _detect_peaks_fixed_xla(norm, 3, 64)
         return norm + jnp.float32(1e-6) * jnp.sum(vals) / n
 
-    dt = chain_time(step, x, iters=2048)
+    st = chain_stat(step, x, iters=2048)
     return {"metric": f"normalize_peaks_b{batch}_n{n}",
-            "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None}
+            **_msps(st, batch * n)}
 
 
 def bench_flagship(scale=1):
@@ -159,10 +166,9 @@ def bench_flagship(scale=1):
 
     # 4096 iters: the causal_fir pipeline got fast enough that 1024
     # chained steps no longer dominate the tunnel RTT floor
-    dt = chain_time(step, sig, iters=4096, null_carry=sig[:1, :8])
+    st = chain_stat(step, sig, iters=4096, null_carry=sig[:1, :8])
     return {"metric": f"flagship_pipeline_b{batch}_n{n}",
-            "value": round(batch * n / dt / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None}
+            **_msps(st, batch * n)}
 
 
 def bench_feed_io(scale=1):
@@ -233,12 +239,11 @@ def bench_stream(scale=1):
         # next chunk depends on this one's outputs: a true serial chain
         return (fs.tail, ss.tail, x + jnp.float32(1e-6) * (hi + lo))
 
-    dt = chain_time(step, (fir0.tail, swt0.tail, x0), iters=4096,
+    st = chain_stat(step, (fir0.tail, swt0.tail, x0), iters=4096,
                     null_carry=(fir0.tail[:1, :4], swt0.tail[:1, :4],
                                 x0[:1, :8]))
     return {"metric": f"stream_fir_swt_b{batch}_chunk{chunk}",
-            "value": round(batch * chunk / dt / 1e6, 1),
-            "unit": "MSamples/s", "vs_baseline": None}
+            **_msps(st, batch * chunk)}
 
 
 def bench_spectral(scale=1):
@@ -259,10 +264,9 @@ def bench_spectral(scale=1):
         p = ops.welch(c, nfft=512, hop=128, impl="xla")
         return c + jnp.float32(1e-9) * jnp.sum(p)
 
-    dt = chain_time(step, x, iters=2048, null_carry=x[:1, :8])
+    st = chain_stat(step, x, iters=2048, null_carry=x[:1, :8])
     return {"metric": f"welch_b{batch}_n{n}_nfft512",
-            "value": round(batch * n / dt / 1e6, 1),
-            "unit": "MSamples/s", "vs_baseline": None}
+            **_msps(st, batch * n)}
 
 
 CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
@@ -270,13 +274,32 @@ CONFIGS = (bench_elementwise, bench_convolve, bench_dwt,
            bench_spectral, bench_feed_io)
 
 
-def run_secondary(stream, scale=None):
+def collect_secondary(scale=None, progress=None) -> dict:
+    """Run every secondary config; {metric: record} for the stdout JSON.
+
+    A config that raises contributes {"error": str} under its function
+    name instead of killing the rest — the driver-parsed line must land
+    with whatever did measure. ``progress`` (a stream) gets one JSON line
+    per config as it completes, for live visibility on stderr."""
     import jax
     if scale is None:
         scale = 1 if jax.default_backend() == "tpu" else 1 / 64
+    out = {}
     for cfg in CONFIGS:
         try:
-            print(json.dumps(cfg(scale)), file=stream, flush=True)
+            rec = cfg(scale)
         except Exception as e:  # keep the headline metric alive regardless
-            print(json.dumps({"metric": cfg.__name__, "error": str(e)}),
-                  file=stream, flush=True)
+            rec = {"metric": cfg.__name__, "error": str(e)[:500]}
+        metric = rec.pop("metric")
+        out[metric] = rec
+        if progress is not None:
+            print(json.dumps({"metric": metric, **rec}), file=progress,
+                  flush=True)
+    return out
+
+
+def run_secondary(stream, scale=None):
+    """Back-compat streamer: one JSON line per config to ``stream``."""
+    for metric, rec in collect_secondary(scale, progress=None).items():
+        print(json.dumps({"metric": metric, **rec}), file=stream,
+              flush=True)

@@ -36,16 +36,21 @@ def make_chain(step_fn, iters: int):
     return chain
 
 
-def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
+def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
                 on_floor: str = "raise", null_carry=None,
                 attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
-    """Per-step seconds for each named step fn, RTT-corrected.
+    """Per-step timing stats for each named step fn, RTT-corrected.
 
     ``steps`` maps name -> (carry -> carry). All configs (plus an implicit
     null chain) are compiled up front, then timed interleaved; returns
-    {name: seconds_per_step}. Raises on non-finite checksums. A config
-    whose total is indistinguishable from the null-chain floor has no
-    meaningful corrected rate: ``on_floor="raise"`` (default) raises,
+    {name: {"sec": corrected_seconds_per_step,
+            "raw_sec": uncorrected_seconds_per_step,
+            "floor_sec": paired_floor_seconds_per_step}}.
+    ``raw_sec`` is the best total wall-clock divided by ``iters`` with no
+    floor subtraction — the unimpeachable lower bound on rate claims.
+    Raises on non-finite checksums. A config whose total is
+    indistinguishable from the null-chain floor has no meaningful
+    corrected rate: ``on_floor="raise"`` (default) raises,
     ``on_floor="nan"`` reports NaN for that config and keeps the rest.
 
     The null chain runs over ``carry`` by default, which also cancels one
@@ -108,10 +113,24 @@ def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
                    f"time dominates — a corrected rate here would be noise")
             if on_floor == "raise":
                 raise RuntimeError(msg)
-            out[name] = float("nan")
+            out[name] = {"sec": float("nan"),
+                         "raw_sec": best_total / iters,
+                         "floor_sec": floors[idx] / iters}
         else:
-            out[name] = best_diff / iters
+            out[name] = {"sec": best_diff / iters,
+                         "raw_sec": best_total / iters,
+                         "floor_sec": floors[idx] / iters}
     return out
+
+
+def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
+                on_floor: str = "raise", null_carry=None,
+                attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
+    """{name: corrected seconds per step} — see chain_stats for details."""
+    stats = chain_stats(steps, carry, iters, reps, on_floor=on_floor,
+                        null_carry=null_carry, attempts=attempts,
+                        attempt_gap_s=attempt_gap_s)
+    return {name: s["sec"] for name, s in stats.items()}
 
 
 def chain_time(step_fn, carry, iters: int, reps: int = 3, *,
@@ -119,5 +138,14 @@ def chain_time(step_fn, carry, iters: int, reps: int = 3, *,
                attempt_gap_s: float = 0.0) -> float:
     """Single-config convenience wrapper over chain_times."""
     return chain_times({"_": step_fn}, carry, iters, reps,
+                       null_carry=null_carry, attempts=attempts,
+                       attempt_gap_s=attempt_gap_s)["_"]
+
+
+def chain_stat(step_fn, carry, iters: int, reps: int = 3, *,
+               null_carry=None, attempts: int = 1,
+               attempt_gap_s: float = 0.0) -> dict:
+    """Single-config convenience wrapper over chain_stats."""
+    return chain_stats({"_": step_fn}, carry, iters, reps,
                        null_carry=null_carry, attempts=attempts,
                        attempt_gap_s=attempt_gap_s)["_"]
