@@ -492,21 +492,45 @@ struct Ring {
   uint64_t pushed = 0;
   uint64_t dropped = 0;
   bool closed = false;   // producer done
+  int waiters = 0;       // consumers inside a cv wait (blocks slot reuse)
+  int64_t self = -1;     // current valid handle; -1 once destroyed.
+                         // Re-checked under mu by every op: a thread that
+                         // resolved the Ring* just before destroy+recycle
+                         // must not touch the successor ring's state.
 };
+// Slot table with generation-tagged handles (gen << 32 | slot) and a
+// free-list of destroyed slots.  Destroy frees the sample buffer and
+// retires the slot; the Ring STRUCT (mutex/cv) is recycled in place by
+// the next create, so long-running ring churn is O(max concurrent
+// rings) memory, not unbounded growth.  The generation bump makes every
+// stale handle resolve to nullptr immediately — strictly tighter than
+// the old keep-forever policy.  Struct reuse (rather than delete) means
+// a racing use-after-destroy can at worst address the successor ring's
+// state, never freed memory.
 std::mutex g_rings_mu;
 std::vector<Ring*> g_rings;
+std::vector<uint32_t> g_ring_gens;
+std::vector<size_t> g_ring_free;
+
+int64_t ring_handle(size_t slot, uint32_t gen) {
+  return static_cast<int64_t>((static_cast<uint64_t>(gen) << 32) |
+                              static_cast<uint64_t>(slot));
+}
 
 Ring* ring_from_handle(int64_t h) {
+  if (h < 0) return nullptr;
+  size_t slot = static_cast<size_t>(h) & 0xffffffffull;
+  uint32_t gen = static_cast<uint32_t>(static_cast<uint64_t>(h) >> 32);
   std::lock_guard<std::mutex> lock(g_rings_mu);
-  if (h < 0 || static_cast<size_t>(h) >= g_rings.size()) return nullptr;
-  return g_rings[static_cast<size_t>(h)];
+  if (slot >= g_rings.size() || g_ring_gens[slot] != gen) return nullptr;
+  return g_rings[slot];
 }
 
 // Copy n samples in (converting if src16) under the lock; returns accepted.
 template <typename Src>
-size_t ring_push_impl(Ring* r, const Src* data, size_t n) {
+size_t ring_push_impl(Ring* r, int64_t h, const Src* data, size_t n) {
   std::unique_lock<std::mutex> lock(r->mu);
-  if (r->closed || !r->buf) return 0;
+  if (r->self != h || r->closed || !r->buf) return 0;
   size_t space = r->cap - r->count;
   size_t take = n < space ? n : space;
   size_t w = (r->head + r->count) % r->cap;
@@ -524,28 +548,52 @@ size_t ring_push_impl(Ring* r, const Src* data, size_t n) {
 
 VH_API int64_t vh_ring_create(size_t capacity_samples, size_t chunk_len) {
   if (chunk_len == 0 || capacity_samples < chunk_len) return -1;
+  float* buf = static_cast<float*>(malloc(capacity_samples * sizeof(float)));
+  if (!buf) return -1;
+  std::lock_guard<std::mutex> lock(g_rings_mu);
+  // Recycle a retired slot whose Ring has no blocked consumer: a waiter
+  // still parked on the old cv must observe closed=true and return -1,
+  // never the successor ring's state (it would steal a chunk or turn
+  // the closed signal into a timeout).
+  for (size_t i = g_ring_free.size(); i-- > 0;) {
+    size_t slot = g_ring_free[i];
+    Ring* r = g_rings[slot];
+    std::lock_guard<std::mutex> rlock(r->mu);
+    if (r->waiters != 0) continue;  // skip: consumer still draining out
+    g_ring_free.erase(g_ring_free.begin() + static_cast<long>(i));
+    r->buf = buf;
+    r->cap = capacity_samples;
+    r->head = 0;
+    r->count = 0;
+    r->chunk = chunk_len;
+    r->pushed = 0;
+    r->dropped = 0;
+    r->closed = false;
+    r->self = ring_handle(slot, g_ring_gens[slot]);
+    return r->self;
+  }
   Ring* r = new (std::nothrow) Ring();
-  if (!r) return -1;
-  r->buf = static_cast<float*>(malloc(capacity_samples * sizeof(float)));
-  if (!r->buf) {
-    delete r;
+  if (!r) {
+    free(buf);
     return -1;
   }
+  r->buf = buf;
   r->cap = capacity_samples;
   r->chunk = chunk_len;
-  std::lock_guard<std::mutex> lock(g_rings_mu);
   g_rings.push_back(r);
-  return static_cast<int64_t>(g_rings.size() - 1);
+  g_ring_gens.push_back(0);
+  r->self = ring_handle(g_rings.size() - 1, 0);
+  return r->self;
 }
 
 VH_API int64_t vh_ring_push_f32(int64_t h, const float* data, size_t n) {
   Ring* r = ring_from_handle(h);
-  return r ? static_cast<int64_t>(ring_push_impl(r, data, n)) : -1;
+  return r ? static_cast<int64_t>(ring_push_impl(r, h, data, n)) : -1;
 }
 
 VH_API int64_t vh_ring_push_i16(int64_t h, const int16_t* data, size_t n) {
   Ring* r = ring_from_handle(h);
-  return r ? static_cast<int64_t>(ring_push_impl(r, data, n)) : -1;
+  return r ? static_cast<int64_t>(ring_push_impl(r, h, data, n)) : -1;
 }
 
 // 1 = chunk copied out; 0 = timeout / not enough data; -1 = closed and
@@ -554,10 +602,12 @@ VH_API int vh_ring_pop_chunk(int64_t h, float* out, int timeout_ms) {
   Ring* r = ring_from_handle(h);
   if (!r) return -1;
   std::unique_lock<std::mutex> lock(r->mu);
-  if (!r->buf) return -1;
+  if (r->self != h || !r->buf) return -1;
   auto have = [&] { return r->count >= r->chunk || r->closed; };
   if (timeout_ms > 0) {
+    r->waiters++;  // destroy-then-recycle must not reuse this slot
     r->cv_data.wait_for(lock, std::chrono::milliseconds(timeout_ms), have);
+    r->waiters--;
   }
   if (r->count < r->chunk) return r->closed ? -1 : 0;
   size_t first = r->cap - r->head;
@@ -577,7 +627,7 @@ VH_API int64_t vh_ring_pop_tail(int64_t h, float* out, size_t max_n) {
   Ring* r = ring_from_handle(h);
   if (!r) return -1;
   std::lock_guard<std::mutex> lock(r->mu);
-  if (!r->buf || !r->closed) return -1;
+  if (r->self != h || !r->buf || !r->closed) return -1;
   size_t n = r->count < max_n ? r->count : max_n;
   for (size_t i = 0; i < n; ++i)
     out[i] = r->buf[(r->head + i) % r->cap];
@@ -590,6 +640,7 @@ VH_API int64_t vh_ring_available(int64_t h) {
   Ring* r = ring_from_handle(h);
   if (!r) return -1;
   std::lock_guard<std::mutex> lock(r->mu);
+  if (r->self != h) return -1;
   return static_cast<int64_t>(r->count);
 }
 
@@ -597,6 +648,7 @@ VH_API int64_t vh_ring_dropped(int64_t h) {
   Ring* r = ring_from_handle(h);
   if (!r) return -1;
   std::lock_guard<std::mutex> lock(r->mu);
+  if (r->self != h) return -1;
   return static_cast<int64_t>(r->dropped);
 }
 
@@ -605,22 +657,33 @@ VH_API int vh_ring_close(int64_t h) {
   Ring* r = ring_from_handle(h);
   if (!r) return -1;
   std::lock_guard<std::mutex> lock(r->mu);
+  if (r->self != h) return -1;
   r->closed = true;
   r->cv_data.notify_all();
   return 0;
 }
 
-// Same stale-handle policy as pools/streams: the Ring struct persists,
-// the sample buffer is freed.
+// Frees the sample buffer, invalidates the handle (generation bump) and
+// retires the slot to the create-time free-list; the Ring struct itself
+// is recycled, not leaked (see the slot-table comment above).
 VH_API int vh_ring_destroy(int64_t h) {
-  Ring* r = ring_from_handle(h);
-  if (!r) return -1;
-  std::lock_guard<std::mutex> lock(r->mu);
-  r->closed = true;
-  free(r->buf);
-  r->buf = nullptr;
-  r->count = 0;
-  r->cv_data.notify_all();  // wake any consumer blocked in pop_chunk
+  if (h < 0) return -1;
+  size_t slot = static_cast<size_t>(h) & 0xffffffffull;
+  uint32_t gen = static_cast<uint32_t>(static_cast<uint64_t>(h) >> 32);
+  std::lock_guard<std::mutex> lock(g_rings_mu);
+  if (slot >= g_rings.size() || g_ring_gens[slot] != gen) return -1;
+  Ring* r = g_rings[slot];
+  {
+    std::lock_guard<std::mutex> rlock(r->mu);
+    r->closed = true;
+    r->self = -1;
+    free(r->buf);
+    r->buf = nullptr;
+    r->count = 0;
+    r->cv_data.notify_all();  // wake any consumer blocked in pop_chunk
+  }
+  g_ring_gens[slot]++;  // stale handles now resolve to nullptr
+  g_ring_free.push_back(slot);
   return 0;
 }
 

@@ -50,6 +50,31 @@ def test_convolve_differential(x_len, h_len, algorithm, rng):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
 
 
+@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
+def test_convolve_batched(algorithm, rng):
+    """(B, N) leading batch dims through every algorithm — row i matches
+    the 1-D oracle (the reference is strictly 1-D; batching is the TPU
+    axis, VERDICT round-1 item 4)."""
+    x_len, h_len = (65536, 127) if algorithm == "overlap_save" else (350, 63)
+    batch = rng.normal(size=(3, x_len)).astype(np.float32)
+    h = rng.normal(size=h_len).astype(np.float32)
+    got = np.asarray(ops.convolve(batch, h, algorithm=algorithm))
+    assert got.shape == (3, x_len + h_len - 1)
+    for i in range(3):
+        ref = ops.convolve(batch[i], h, impl="reference")
+        np.testing.assert_allclose(got[i], ref, rtol=2e-4, atol=2e-3)
+
+
+def test_convolve_batched_2d_lead(rng):
+    """Two leading axes broadcast too (shape-agnostic contract)."""
+    batch = rng.normal(size=(2, 3, 200)).astype(np.float32)
+    h = rng.normal(size=31).astype(np.float32)
+    got = np.asarray(ops.convolve(batch, h, algorithm="fft"))
+    assert got.shape == (2, 3, 230)
+    ref = ops.convolve(batch[1, 2], h, impl="reference")
+    np.testing.assert_allclose(got[1, 2], ref, rtol=2e-4, atol=2e-3)
+
+
 def test_convolve_commutative(rng):
     # conv(x, h) == conv(h, x); the reference's FFT path is symmetric too.
     x = rng.normal(size=100).astype(np.float32)
@@ -177,3 +202,34 @@ class TestAlgorithmEquivalenceFuzz:
         got = np.asarray(ops.cross_correlate(x, h))
         scale = np.abs(want).max() + 1.0
         np.testing.assert_allclose(got / scale, want / scale, atol=5e-5)
+
+
+class TestPallasDirect:
+    """Third-backend leg for the direct algorithm (pallas/convolve.py;
+    the aliasing idiom of arithmetic-inl.h:981-998 made a real kernel)."""
+
+    @pytest.mark.parametrize("x_len,h_len",
+                             [(32, 5), (350, 63), (1020, 127), (333, 77)])
+    def test_differential(self, rng, x_len, h_len):
+        x = rng.normal(size=x_len).astype(np.float32)
+        h = rng.normal(size=h_len).astype(np.float32)
+        ref = ops.convolve(x, h, impl="reference")
+        got = np.asarray(ops.convolve(x, h, algorithm="direct",
+                                      impl="pallas"))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
+
+    def test_batched(self, rng):
+        batch = rng.normal(size=(4, 350)).astype(np.float32)
+        h = rng.normal(size=31).astype(np.float32)
+        got = np.asarray(ops.convolve(batch, h, algorithm="direct",
+                                      impl="pallas"))
+        want = np.asarray(ops.convolve(batch, h, algorithm="direct"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_correlate_pallas(self, rng):
+        x = rng.normal(size=200).astype(np.float32)
+        h = rng.normal(size=17).astype(np.float32)
+        ref = ops.cross_correlate(x, h, impl="reference")
+        got = np.asarray(ops.cross_correlate(x, h, algorithm="direct",
+                                             impl="pallas"))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)

@@ -28,6 +28,20 @@ def test_correlate_golden(algorithm):
     np.testing.assert_allclose(got, GOLDEN_CORR, atol=1e-3)
 
 
+@pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
+def test_correlate_batched(algorithm, rng):
+    """(B, N) through the reversed-h delegation — row i matches the 1-D
+    oracle for every algorithm."""
+    x_len, h_len = (65536, 127) if algorithm == "overlap_save" else (350, 63)
+    batch = rng.normal(size=(3, x_len)).astype(np.float32)
+    h = rng.normal(size=h_len).astype(np.float32)
+    got = np.asarray(ops.cross_correlate(batch, h, algorithm=algorithm))
+    assert got.shape == (3, x_len + h_len - 1)
+    for i in range(3):
+        ref = ops.cross_correlate(batch[i], h, impl="reference")
+        np.testing.assert_allclose(got[i], ref, rtol=2e-4, atol=2e-3)
+
+
 @pytest.mark.parametrize("x_len,h_len", SIZES)
 @pytest.mark.parametrize("algorithm", ["direct", "fft", "overlap_save"])
 def test_correlate_differential(x_len, h_len, algorithm, rng):

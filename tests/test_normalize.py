@@ -119,3 +119,42 @@ class TestPrecomputedStatsPassthrough:
             want = src.astype(np.float64) / (127.5 / 2) - 1
             np.testing.assert_allclose(out, want, atol=1e-5)
         assert out.max() > 1.0  # 255 maps to 3.0, untouched
+
+
+class TestPallasLeg:
+    """Third-backend leg for the 1-D reduction family
+    (pallas/normalize.py): differential vs the float64 oracle and the
+    XLA twin."""
+
+    def test_minmax1D_oracle(self, rng):
+        # the float64 oracle is strictly 1-D (minmax1D semantics,
+        # normalize.c:318-367)
+        src = rng.normal(size=301).astype(np.float32)
+        want_min, want_max = N.minmax1D(src, impl="reference")
+        got_min, got_max = N.minmax1D(src, impl="pallas")
+        np.testing.assert_allclose(np.asarray(got_min), want_min, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_max), want_max, atol=1e-6)
+
+    @pytest.mark.parametrize("shape", [(64,), (3, 128), (2, 5, 300),
+                                       (4, 4096)])
+    def test_minmax1D_matches_xla(self, rng, shape):
+        # batch-aware per-row semantics: the XLA twin is the contract
+        src = rng.normal(size=shape).astype(np.float32)
+        want_min, want_max = N.minmax1D(src, impl="xla")
+        got_min, got_max = N.minmax1D(src, impl="pallas")
+        np.testing.assert_allclose(np.asarray(got_min),
+                                   np.asarray(want_min), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_max),
+                                   np.asarray(want_max), atol=1e-6)
+
+    @pytest.mark.parametrize("shape", [(64,), (3, 130), (16, 4096)])
+    def test_normalize1D(self, rng, shape):
+        src = rng.normal(size=shape).astype(np.float32)
+        want = np.asarray(N.normalize1D(src, impl="xla"))
+        got = np.asarray(N.normalize1D(src, impl="pallas"))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_normalize1D_constant_rows_zero_fill(self):
+        src = np.ones((2, 64), np.float32)
+        got = np.asarray(N.normalize1D(src, impl="pallas"))
+        np.testing.assert_array_equal(got, np.zeros_like(src))

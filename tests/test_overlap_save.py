@@ -106,3 +106,43 @@ class TestConvolveOverlapSaveSharded:
         want = np.convolve(x.astype(np.float64), h.astype(np.float64))[:n]
         got = np.asarray(convolve_overlap_save_sharded(x, h, mesh))
         np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+class TestStepShrinkGuardrail:
+    def test_warns_when_fast_step_degrades(self, mesh):
+        # m=1537, shard=3398 < 2*8192 -> compact policy L=4096, step
+        # 2560 >= the 2048 floor. 3398 % 2560 != 0 and the divisors of
+        # 3398 = 2*1699 (1699 prime) leave 1699 as the largest >= the
+        # 1536 overlap: the fast step degrades below the floor -> warn.
+        import warnings
+        n = 8 * 3398  # shard 3398 per device on the 8-mesh
+        m = 1537
+        x = np.zeros(n, np.float32)
+        h = np.ones(m, np.float32) / m
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = convolve_overlap_save_sharded(x, h, mesh)
+            np.asarray(got)
+        assert any("auto-shrunk" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+
+    def test_small_policy_configs_stay_quiet(self, rng, mesh):
+        # policy step below the floor from the start: nothing was lost,
+        # no warning (n=1024, m=9 -> compact policy L=32, step 24)
+        import warnings
+        x = rng.normal(size=1024).astype(np.float32)
+        h = rng.normal(size=9).astype(np.float32)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.asarray(convolve_overlap_save_sharded(x, h, mesh))
+        assert not any("auto-shrunk" in str(x.message) for x in w)
+
+    def test_large_shards_take_tpu_block_policy(self, rng, mesh):
+        # shard 32768 >= 2*8192: the default block policy is the TPU
+        # floor, and correctness is unchanged
+        n, m = 8 * 32768, 127
+        x = rng.normal(size=n).astype(np.float32)
+        h = rng.normal(size=m).astype(np.float32)
+        want = np.asarray(ops.convolve(x, h, algorithm="overlap_save"))[:n]
+        got = np.asarray(convolve_overlap_save_sharded(x, h, mesh))
+        np.testing.assert_allclose(got, want, atol=2e-3)

@@ -51,7 +51,8 @@ class TestConvolveSharded:
 
 
 class TestWaveletSharded:
-    @pytest.mark.parametrize("ext", ["periodic", "zero"])
+    @pytest.mark.parametrize("ext", ["periodic", "zero", "mirror",
+                                     "constant"])
     @pytest.mark.parametrize("order", [4, 8])
     def test_dwt(self, rng, mesh, ext, order):
         x = rng.normal(size=512).astype(np.float32)
@@ -65,12 +66,14 @@ class TestWaveletSharded:
                                    atol=1e-4)
 
     @pytest.mark.parametrize("level", [1, 2, 3])
-    def test_swt(self, rng, mesh, level):
+    @pytest.mark.parametrize("ext", ["periodic", "zero", "mirror",
+                                     "constant"])
+    def test_swt(self, rng, mesh, level, ext):
         x = rng.normal(size=1024).astype(np.float32)
         want_hi, want_lo = ops.stationary_wavelet_apply(
-            x, "daubechies", 8, level, "periodic", impl="xla")
+            x, "daubechies", 8, level, ext, impl="xla")
         hi, lo = parallel.stationary_wavelet_apply_sharded(
-            x, "daubechies", 8, level, "periodic", mesh=mesh)
+            x, "daubechies", 8, level, ext, mesh=mesh)
         np.testing.assert_allclose(np.asarray(hi), np.asarray(want_hi),
                                    atol=1e-4)
         np.testing.assert_allclose(np.asarray(lo), np.asarray(want_lo),
@@ -84,11 +87,17 @@ class TestWaveletSharded:
                                            "daubechies", 4, "periodic",
                                            mesh=mesh)
 
-    def test_mirror_rejected(self, mesh):
+    def test_unknown_extension_rejected(self, mesh):
         with pytest.raises(ValueError):
             parallel.wavelet_apply_sharded(np.zeros(512, np.float32),
-                                           "daubechies", 8, "mirror",
+                                           "daubechies", 8, "bogus",
                                            mesh=mesh)
+
+    def test_left_mirror_halo_rejected(self, mesh):
+        # left mirror/constant halos genuinely need the far shard
+        from veles.simd_tpu.parallel.halo import halo_map
+        with pytest.raises(ValueError):
+            halo_map(lambda x: x, mesh, "seq", left=4, boundary="mirror")
 
 
 class TestBatchMap:
@@ -136,7 +145,7 @@ class TestHaloContracts:
 
     def test_bad_boundary_rejected(self, mesh):
         with pytest.raises(ValueError):
-            parallel.halo_map(lambda x: x, mesh, boundary="mirror")
+            parallel.halo_map(lambda x: x, mesh, boundary="bogus")
 
 
 class TestShardedDecompose:

@@ -103,6 +103,33 @@ def test_peaks_stream_boundary_peak(rng):
     assert 63 in got_pos.tolist() and 64 in got_pos.tolist()
 
 
+def test_peaks_stream_truncation():
+    """Pin the per-STEP capacity semantics: each chunk keeps its first
+    ``capacity`` decidable peaks, so the stream union can retain later
+    peaks a capacity-limited whole-signal call would drop (documented in
+    peaks_stream_step; ADVICE round-1 item)."""
+    # alternating signal: every interior odd index is a max, evens are
+    # mins -> with EXTREMUM_TYPE_BOTH every interior point is a peak
+    x = np.tile(np.array([1.0, -1.0], np.float32), 64)  # n = 128
+    chunk, cap = 32, 4
+    got_pos, _ = _stream_peaks(x, chunk, capacity_per_chunk=cap)
+    # 4 chunks x 4 peaks: the FIRST 4 decidable per chunk
+    assert len(got_pos) == 4 * cap
+    # each chunk k decides global positions [32k-1, 32(k+1)-2]; its kept
+    # peaks are the first cap of those
+    want = []
+    for k in range(4):
+        lo = max(1, 32 * k - 1)
+        want.extend(range(lo, lo + cap))
+    np.testing.assert_array_equal(np.sort(got_pos), np.sort(want))
+    # the whole-signal call at the same capacity keeps only the global
+    # first cap -> strictly fewer, earlier positions
+    pos_w, _, cnt_w = ops.detect_peaks_fixed(x, capacity=cap)
+    np.testing.assert_array_equal(np.asarray(pos_w)[:int(cnt_w)],
+                                  got_pos[:cap])
+    assert int(cnt_w) == cap
+
+
 def test_peaks_stream_first_sample_not_tested():
     """Global index 0 is never a peak (whole-signal interior starts at 1,
     detect_peaks.c:67) even when the stream opens with a local max."""
@@ -370,6 +397,17 @@ def test_istft_stream_roundtrip(rng, nfft, hop, chunk):
     y = np.concatenate(outs)
     assert y.shape == x.shape  # one sample out per sample in
     np.testing.assert_allclose(y[nfft:], x[nfft - d:n - d], atol=2e-6)
+    # the warm-up span (incomplete window coverage) emits exact zeros,
+    # never attenuated partial sums (ADVICE round-1 item)
+    np.testing.assert_array_equal(y[:d], np.zeros(d, np.float32))
+
+
+def test_istft_stream_empty_chunk_rejected():
+    """F_c == 0 fails with a clear ValueError, not an opaque IndexError."""
+    sr = ops.istft_stream_init(64, 16)
+    empty = np.zeros((0, 33), np.complex64)
+    with pytest.raises(ValueError, match="at least one frame"):
+        ops.istft_stream_step(sr, empty, nfft=64, hop=16)
 
 
 def test_istft_stream_rect_unit_hop_nfft(rng):

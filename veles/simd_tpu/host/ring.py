@@ -118,8 +118,11 @@ class RingBuffer:
                     timeout)
             if self._count < self.chunk_len:
                 return None
-            idx = (self._head + np.arange(self.chunk_len)) % self.capacity
-            out[:] = self._buf[idx]
+            # wrap-aware two-slice copy (the native path's two-memcpy
+            # form); fancy indexing builds an index array per pop
+            first = min(self.chunk_len, self.capacity - self._head)
+            out[:first] = self._buf[self._head:self._head + first]
+            out[first:] = self._buf[:self.chunk_len - first]
             self._head = (self._head + self.chunk_len) % self.capacity
             self._count -= self.chunk_len
             return out
@@ -141,8 +144,10 @@ class RingBuffer:
             if not self._closed:
                 raise RuntimeError("tail() before close()")
             n = self._count
-            idx = (self._head + np.arange(n)) % self.capacity
-            out = self._buf[idx].copy()
+            out = np.empty(n, np.float32)
+            first = min(n, self.capacity - self._head)
+            out[:first] = self._buf[self._head:self._head + first]
+            out[first:] = self._buf[:n - first]
             self._head = (self._head + n) % self.capacity
             self._count = 0
             return out

@@ -121,6 +121,10 @@ def _convolve_direct_xla(x, h, reverse=False):
     explicit ``algorithm="direct"`` requests past _DIRECT_UNROLL_MAX_H
     take the degenerate conv lowering: slow, but it returns a result
     where tracing 10^5 slices would hang.
+
+    Batch-aware: leading axes of ``x`` broadcast through both paths (the
+    reference is strictly 1-D, convolve.h:41-125; batching is the TPU
+    axis and the shifted multiply-adds are shape-agnostic).
     """
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
@@ -128,10 +132,11 @@ def _convolve_direct_xla(x, h, reverse=False):
         h = h[::-1]  # correlation orientation
     n, m = x.shape[-1], h.shape[-1]
     n_out = n + m - 1
+    lead = x.shape[:-1]
     if m > _DIRECT_UNROLL_MAX_H:
         # lax conv is cross-correlation (no kernel flip) — h is already in
         # correlation orientation here
-        lhs = x.reshape(1, 1, n)
+        lhs = x.reshape(-1, 1, n)
         rhs = h.reshape(1, 1, m)
         # HIGHEST: the direct algorithm's contract is f32 accuracy (the
         # unrolled path is f32 on the VPU); the TPU default would run
@@ -140,11 +145,11 @@ def _convolve_direct_xla(x, h, reverse=False):
             lhs, rhs, window_strides=(1,), padding=[(m - 1, m - 1)],
             dimension_numbers=("NCH", "OIH", "NCH"),
             precision=jax.lax.Precision.HIGHEST)
-        return out.reshape(n_out)
-    padded = jnp.pad(x, (m - 1, m - 1))
-    acc = jnp.zeros(n_out, jnp.float32)
+        return out.reshape(lead + (n_out,))
+    padded = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(m - 1, m - 1)])
+    acc = jnp.zeros(lead + (n_out,), jnp.float32)
     for j in range(m):
-        acc = acc + padded[j:j + n_out] * h[j]
+        acc = acc + padded[..., j:j + n_out] * h[j]
     return acc
 
 
@@ -195,15 +200,23 @@ def _convolve_fft_xla(x, h, fft_length, out_length, reverse=False):
     h = jnp.asarray(h, jnp.float32)
     if reverse:
         h = h[::-1]
-    # Batched forward transform of {x, h} — the fftf_init_batch analogue
-    # (convolve.c:264-268).
-    stacked = jnp.stack([
-        jnp.pad(x, (0, fft_length - x.shape[-1])),
-        jnp.pad(h, (0, fft_length - h.shape[-1])),
-    ])
-    spectra = jnp.fft.rfft(stacked, axis=-1)
-    out = jnp.fft.irfft(spectra[0] * spectra[1], n=fft_length)
-    return out[:out_length].astype(jnp.float32)
+    if x.ndim == 1:
+        # Batched forward transform of {x, h} — the fftf_init_batch
+        # analogue (convolve.c:264-268).
+        stacked = jnp.stack([
+            jnp.pad(x, (0, fft_length - x.shape[-1])),
+            jnp.pad(h, (0, fft_length - h.shape[-1])),
+        ])
+        spectra = jnp.fft.rfft(stacked, axis=-1)
+        out = jnp.fft.irfft(spectra[0] * spectra[1], n=fft_length)
+        return out[:out_length].astype(jnp.float32)
+    # Batch-aware: the signal batch is itself the batched transform; H is
+    # computed once and broadcast over the leading axes.
+    xs = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, fft_length - x.shape[-1])])
+    spectra = jnp.fft.rfft(xs, axis=-1)
+    H = jnp.fft.rfft(jnp.pad(h, (0, fft_length - h.shape[-1])))
+    out = jnp.fft.irfft(spectra * H, n=fft_length, axis=-1)
+    return out[..., :out_length].astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -227,17 +240,21 @@ def _convolve_overlap_save_xla(x, h, L, out_length, reverse=False):
     # convolve.c:181-228. The overlapping windows are materialized with two
     # strided reshapes + a concat (block body / next block's first m-1
     # samples), never a gather: TPU gathers serialize, and this exact
-    # formulation is 9x faster (see policy table above).
+    # formulation is 9x faster (see policy table above). Leading axes of
+    # ``x`` are batch: blocks of every signal ride one batched FFT.
+    lead = x.shape[:-1]
     total = (n_blocks + 1) * step
-    padded = jnp.pad(x, (m - 1, total - x.shape[-1]))   # (total + m - 1,)
-    body = padded[:n_blocks * step].reshape(n_blocks, step)
-    halo = padded[step:(n_blocks + 1) * step].reshape(n_blocks, step)[:, :m - 1]
-    blocks = jnp.concatenate([body, halo], axis=1)      # (n_blocks, L)
+    padded = jnp.pad(x, [(0, 0)] * (x.ndim - 1)
+                     + [(m - 1, total - x.shape[-1])])  # (..., total + m - 1)
+    body = padded[..., :n_blocks * step].reshape(lead + (n_blocks, step))
+    halo = padded[..., step:(n_blocks + 1) * step].reshape(
+        lead + (n_blocks, step))[..., :m - 1]
+    blocks = jnp.concatenate([body, halo], axis=-1)     # (..., n_blocks, L)
     H = jnp.fft.rfft(jnp.pad(h, (0, L - m)))
     spectra = jnp.fft.rfft(blocks, axis=-1)             # batched: all blocks
-    conv = jnp.fft.irfft(spectra * H[None, :], n=L, axis=-1)
-    useful = conv[:, m - 1:]                            # step samples per block
-    return useful.reshape(-1)[:out_length].astype(jnp.float32)
+    conv = jnp.fft.irfft(spectra * H, n=L, axis=-1)
+    useful = conv[..., m - 1:]                          # step samples per block
+    return useful.reshape(lead + (-1,))[..., :out_length].astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -272,8 +289,15 @@ class ConvolutionHandle:
 
 def convolve_initialize(x_length: int, h_length: int,
                         algorithm: Optional[str] = None,
-                        reverse: bool = False) -> ConvolutionHandle:
-    """Pick an algorithm for the shapes and build the specialized closure."""
+                        reverse: bool = False,
+                        impl: Optional[str] = None) -> ConvolutionHandle:
+    """Pick an algorithm for the shapes and build the specialized closure.
+
+    ``impl="pallas"`` selects the hand VPU kernel for the direct
+    algorithm (pallas/convolve.py). The fft/overlap-save algorithms have
+    no Pallas leg by design: their kernel IS the FFT, which XLA owns —
+    see docs/parity.md.
+    """
     if x_length <= 0 or h_length <= 0:
         raise ValueError("x_length and h_length must be positive")
     if algorithm is None:
@@ -282,7 +306,15 @@ def convolve_initialize(x_length: int, h_length: int,
         raise ValueError(f"algorithm must be one of {ALGORITHMS}")
     out_length = x_length + h_length - 1
     if algorithm == "direct":
-        fn = functools.partial(_convolve_direct_xla, reverse=reverse)
+        if (resolve_impl(impl) == "pallas"
+                and h_length <= _DIRECT_UNROLL_MAX_H):
+            # same unroll ceiling as the XLA path: the kernel's tap loop
+            # is linear in h at trace time; oversized requests take the
+            # shared degenerate-conv fallback below
+            from veles.simd_tpu.pallas.convolve import convolve_direct
+            fn = functools.partial(convolve_direct, reverse=reverse)
+        else:
+            fn = functools.partial(_convolve_direct_xla, reverse=reverse)
     elif algorithm == "fft":
         fft_length = fft_convolution_length(x_length, h_length)
         fn = functools.partial(_convolve_fft_xla, fft_length=fft_length,
@@ -303,13 +335,19 @@ def convolve_finalize(handle: ConvolutionHandle) -> None:
 
 
 def convolve(x, h, *, algorithm: Optional[str] = None, impl=None):
-    """Full linear convolution, length x+h-1 (one-shot form)."""
+    """Full linear convolution, length x+h-1 (one-shot form).
+
+    Batch-aware: leading axes of ``x`` broadcast through all three
+    algorithms (the reference is strictly 1-D, convolve.h:41-125;
+    batching is the TPU axis). ``h`` is one filter, shared by the batch.
+    """
     impl = resolve_impl(impl)
     if impl == "reference":
         return _ref.convolve(x, h)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
-    handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm)
+    handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm,
+                                 impl=impl)
     return handle(x, h)
 
 

@@ -23,9 +23,11 @@ from veles.simd_tpu.reference import correlate as _ref
 
 
 def cross_correlate_initialize(x_length: int, h_length: int,
-                               algorithm: Optional[str] = None
+                               algorithm: Optional[str] = None,
+                               impl: Optional[str] = None
                                ) -> ConvolutionHandle:
-    return convolve_initialize(x_length, h_length, algorithm, reverse=True)
+    return convolve_initialize(x_length, h_length, algorithm, reverse=True,
+                               impl=impl)
 
 
 def cross_correlate_finalize(handle) -> None:
@@ -38,7 +40,8 @@ def cross_correlate(x, h, *, algorithm: Optional[str] = None, impl=None):
         return _ref.cross_correlate(x, h)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
-    handle = cross_correlate_initialize(x.shape[-1], h.shape[-1], algorithm)
+    handle = cross_correlate_initialize(x.shape[-1], h.shape[-1], algorithm,
+                                        impl=impl)
     return handle(x, h)
 
 

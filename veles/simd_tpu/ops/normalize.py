@@ -79,6 +79,16 @@ def _normalize1D_xla(src):
     return rescale_minmax(src, vmin, vmax, clip=True)
 
 
+def _normalize1D_pallas(src):
+    from veles.simd_tpu.pallas.normalize import normalize1D as _p
+    return _p(src)
+
+
+def _minmax1D_pallas(src):
+    from veles.simd_tpu.pallas.normalize import minmax1D as _p
+    return _p(src)
+
+
 def normalize1D(src, *, impl=None):
     """Float signal -> [-1, 1] over the last axis; constant signals
     zero-fill, matching normalize2D's policy (normalize.c:44-47).
@@ -87,7 +97,8 @@ def normalize1D(src, *, impl=None):
     scaling (normalize.h:84-90); this is that pairing as one op, batch-aware
     over leading axes.
     """
-    return dispatch(impl, _ref.normalize1D, _normalize1D_xla)(src)
+    return dispatch(impl, _ref.normalize1D, _normalize1D_xla,
+                    _normalize1D_pallas)(src)
 
 
 def minmax2D(src, *, impl=None):
@@ -97,7 +108,8 @@ def minmax2D(src, *, impl=None):
 
 def minmax1D(src, *, impl=None):
     """(min, max) over a float signal (normalize.c:318-367)."""
-    return dispatch(impl, _ref.minmax1D, _minmax1D_xla)(src)
+    return dispatch(impl, _ref.minmax1D, _minmax1D_xla,
+                    _minmax1D_pallas)(src)
 
 
 def normalize2D_minmax(vmin, vmax, src, *, impl=None):

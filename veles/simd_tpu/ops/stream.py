@@ -163,9 +163,18 @@ def peaks_stream_step(state: PeaksStreamState, chunk,
 
     Positions are **global** stream indices (-1 pads past ``count``).
     The union of all steps' peaks equals ``detect_peaks_fixed`` on the
-    whole stream: each step reports the peaks whose interior test became
-    decidable with this chunk — global positions offset-2+1 .. offset+L-2
-    relative to the carry-extended block.
+    whole stream *when capacity does not truncate*: each step reports the
+    peaks whose interior test became decidable with this chunk — global
+    positions offset-2+1 .. offset+L-2 relative to the carry-extended
+    block.
+
+    Truncation semantics differ by construction: ``capacity`` here is
+    per-STEP (each chunk keeps its first ``capacity`` decidable peaks),
+    while the whole-signal op keeps the first ``capacity`` of the entire
+    signal. A stream whose early chunks truncate can therefore retain
+    later peaks a capacity-limited whole-signal call would have dropped;
+    with per-chunk peak counts <= capacity the two are identical
+    (pinned by tests/test_stream.py::test_peaks_stream_truncation).
     """
     chunk = jnp.asarray(chunk, jnp.float32)
     # a step decides exactly chunk-many interior points; clamp like
@@ -375,8 +384,10 @@ def stft_stream_step(state: StftStreamState, chunk, *, nfft: int,
 class IstftStreamState(NamedTuple):
     """Carry for streaming inverse STFT: the trailing ``nfft - hop``
     samples of the running overlap-add accumulation (frames that will
-    also receive contributions from frames yet to arrive)."""
+    also receive contributions from frames yet to arrive), plus the
+    count of samples emitted so far (masks the warm-up span)."""
     carry: jax.Array
+    emitted: jax.Array | int = 0  # int default: no device touch at import
 
 
 def istft_stream_init(nfft: int, hop: int | None = None,
@@ -385,7 +396,8 @@ def istft_stream_init(nfft: int, hop: int | None = None,
     hop = nfft // 4 if hop is None else hop
     stft_stream_warmup(nfft, hop)  # validates the pair
     return IstftStreamState(
-        jnp.zeros((*batch_shape, nfft - hop), jnp.float32))
+        jnp.zeros((*batch_shape, nfft - hop), jnp.float32),
+        jnp.int32(0))
 
 
 @functools.partial(jax.jit, static_argnames=("nfft", "hop"))
@@ -402,6 +414,11 @@ def istft_stream_step(state: IstftStreamState, spec, *, nfft: int,
     mask), the concatenated output equals the input stream delayed by
     ``nfft - hop`` samples wherever the steady-state window coverage is
     complete — real-time spectral processing with fixed latency.
+
+    The first ``nfft - hop`` samples of the stream (the warm-up span,
+    where window coverage is incomplete because pre-stream frames never
+    existed) are emitted as EXACT ZEROS rather than attenuated
+    partial sums, so callers cannot mistake them for valid output.
     """
     from veles.simd_tpu.ops import spectral
 
@@ -423,6 +440,10 @@ def istft_stream_step(state: IstftStreamState, spec, *, nfft: int,
             f"spectrum has {jnp.shape(spec)[-1]} bins, expected "
             f"nfft//2+1 = {nfft // 2 + 1} (was the analysis run with a "
             f"different nfft?)")
+    if len(jnp.shape(spec)) < 2 or jnp.shape(spec)[-2] < 1:
+        raise ValueError(
+            f"spec must be (..., F_c, nfft//2+1) with at least one "
+            f"frame; got shape {jnp.shape(spec)}")
     spec = jnp.asarray(spec)
     frames = jnp.fft.irfft(spec, n=nfft, axis=-1) * window
     _check_stream_batch(state.carry, frames[..., 0, :],
@@ -438,7 +459,17 @@ def istft_stream_step(state: IstftStreamState, spec, *, nfft: int,
     den = jnp.tile(den, n_emit // hop)
     eps = jnp.float32(1e-12)
     out = acc[..., :n_emit] / jnp.maximum(den, eps) * (den > eps)
-    return IstftStreamState(acc[..., n_emit:]), out
+    # warm-up masking: global sample indices below nfft - hop never got
+    # their full window coverage — emit zeros, not attenuated sums. The
+    # counter saturates at nfft (all it must distinguish is the warm-up
+    # span): an int32 that kept counting would wrap after 2^31 samples
+    # (~12 h at 48 kHz) and re-zero the stream forever.
+    emitted = jnp.asarray(state.emitted, jnp.int32)
+    glob = emitted + jnp.arange(n_emit, dtype=jnp.int32)
+    out = jnp.where(glob >= nfft - hop, out, jnp.float32(0))
+    return IstftStreamState(
+        acc[..., n_emit:],
+        jnp.minimum(emitted + n_emit, jnp.int32(nfft))), out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,84 @@
+"""Direct (brute-force) convolution Pallas kernel (VPU).
+
+The third backend leg for the direct algorithm (the reference ships a
+SIMD twin for every op — the aliasing idiom of arithmetic-inl.h:981-998;
+its brute-force kernel is the per-output reversed dot of
+src/convolve.c:40-101). The formulation matches the XLA shift-add path
+(ops/convolve.py:_convolve_direct_xla): the m taps become m unit-stride
+shifted multiply-adds over the padded signal, fused here into one
+explicit VPU pass per block.
+
+Unlike the wavelet banks (whose taps are compile-time table constants),
+the filter is runtime data: it rides in as a (1, m) VMEM operand
+replicated to every grid block, and the Python tap loop indexes it with
+static offsets — same schedule, no recompilation per filter value.
+
+Gridded and batched exactly like pallas/wavelet.py: output axis tiled
+into VMEM-sized blocks whose input blocks overlap by the m-1 halo
+(element-indexed block dims), leading dims ride the batch grid axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import Element as _Element
+from jax.experimental.pallas import tpu as pltpu
+
+from veles.simd_tpu.pallas import use_interpret
+from veles.simd_tpu.pallas.wavelet import _LANES, _pad_to, _tile
+
+
+def _fir_kernel(x_ref, taps_ref, o_ref, *, order, out_len):
+    x = x_ref[...]
+    acc = jnp.zeros(o_ref.shape, jnp.float32)
+    for j in range(order):  # static offsets; taps are runtime values
+        acc = acc + taps_ref[0, j] * x[:, j:j + out_len]
+    o_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("order", "out_length"))
+def _fir_call(x_pad, taps, order, out_length):
+    halo = order - 1
+    lead = x_pad.shape[:-1]
+    batch = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    x2 = x_pad.reshape(batch, x_pad.shape[-1])
+
+    bb, bl = _tile(batch, max(out_length, _LANES))
+    out_len = -(-out_length // bl) * bl
+    x2 = _pad_to(x2, out_len + halo)
+    kernel = functools.partial(_fir_kernel, order=order, out_len=bl)
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch // bb, out_len // bl),
+        in_specs=[pl.BlockSpec((bb, _Element(bl + halo, (0, 0))),
+                               lambda i, j: (i, j * bl)),
+                  pl.BlockSpec((1, order), lambda i, j: (0, 0))],
+        out_specs=pl.BlockSpec((bb, bl), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((batch, out_len), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=use_interpret(),
+    )(x2, taps.reshape(1, order))
+    return out[:, :out_length].reshape(lead + (out_length,))
+
+
+def convolve_direct(x, h, *, reverse=False):
+    """Full linear convolution (length x+h-1), brute-force schedule.
+
+    out[t] = sum_j h_corr[j] * padded[t + j] where h_corr is h reversed
+    into correlation orientation (``reverse=True`` skips the flip — the
+    cross-correlation kernel of src/correlate.c:74-126). Leading axes of
+    ``x`` are batch.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if not reverse:
+        h = h[::-1]
+    n, m = x.shape[-1], h.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 1) + [(m - 1, m - 1)]
+    return _fir_call(jnp.pad(x, pad), h, m, n + m - 1)

@@ -1,0 +1,86 @@
+"""Row min/max reduction Pallas kernel (VPU) — the normalize leg.
+
+Third backend for minmax1D / normalize1D (the SIMD twins of
+src/normalize.c:318-367's minmax1D and the paired rescale). The
+reduction tiles each signal row into VMEM blocks, accumulating the
+running (min, max) in a scratch pair across the block grid dimension —
+the Pallas form of the reference's 8-wide running `_mm256_min_ps`
+accumulators (normalize.c:330-346).
+
+The affine [-1, 1] rescale stays in XLA on purpose: it is one fused
+elementwise map (the kind of fusion XLA owns); the hand kernel earns its
+keep on the reduction, where the block schedule matters.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles.simd_tpu.pallas import use_interpret
+from veles.simd_tpu.pallas.wavelet import _LANES, _tile
+
+
+def _minmax_kernel(x_ref, min_ref, max_ref, acc_min, acc_max):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        acc_min[:] = jnp.full(acc_min.shape, jnp.inf, jnp.float32)
+        acc_max[:] = jnp.full(acc_max.shape, -jnp.inf, jnp.float32)
+
+    x = x_ref[...]
+    acc_min[:] = jnp.minimum(acc_min[:], jnp.min(x, axis=-1, keepdims=True))
+    acc_max[:] = jnp.maximum(acc_max[:], jnp.max(x, axis=-1, keepdims=True))
+
+    @pl.when(pl.program_id(1) == pl.num_programs(1) - 1)
+    def _flush():
+        min_ref[...] = acc_min[:]
+        max_ref[...] = acc_max[:]
+
+
+@jax.jit
+def _minmax_call(x2):
+    batch, n = x2.shape
+    bb, bl = _tile(batch, max(n, _LANES))
+    padded_n = -(-n // bl) * bl
+    if padded_n != n:
+        # pad with the first sample of each row: never affects min/max
+        x2 = jnp.concatenate(
+            [x2, jnp.broadcast_to(x2[:, :1], (batch, padded_n - n))], axis=1)
+    vmin, vmax = pl.pallas_call(
+        _minmax_kernel,
+        grid=(batch // bb, padded_n // bl),
+        in_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))],
+        out_specs=[pl.BlockSpec((bb, 1), lambda i, j: (i, 0))] * 2,
+        out_shape=[jax.ShapeDtypeStruct((batch, 1), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((bb, 1), jnp.float32)] * 2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=use_interpret(),
+    )(x2)
+    return vmin, vmax
+
+
+def minmax1D(x):
+    """Per-row (min, max) over the last axis; leading dims are batch.
+    Scalars come back with the last axis reduced away (minmax1D
+    semantics, normalize.c:318-367)."""
+    x = jnp.asarray(x, jnp.float32)
+    lead = x.shape[:-1]
+    batch = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    vmin, vmax = _minmax_call(x.reshape(batch, x.shape[-1]))
+    return vmin.reshape(lead), vmax.reshape(lead)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def normalize1D(x):
+    """[-1, 1] normalization: Pallas minmax reduction + XLA rescale."""
+    from veles.simd_tpu.ops.normalize import rescale_minmax
+
+    x = jnp.asarray(x, jnp.float32)
+    vmin, vmax = minmax1D(x)
+    return rescale_minmax(x, vmin[..., None], vmax[..., None], clip=True)

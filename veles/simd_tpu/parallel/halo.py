@@ -16,8 +16,15 @@ Boundary policy at the global signal ends:
   * ``"periodic"`` — the circular ppermute wrap-around IS the periodic
     extension (circular convolution semantics; EXTENSION_PERIODIC) — no
     masking, no extra traffic.
-Mirror/constant extensions need values from the far ends and are
-deliberately not offered sharded; gather first if you need them.
+  * ``"mirror"`` / ``"constant"`` — right-halo only: the framework's
+    extension contract is right-extension (initialize_extension,
+    src/wavelet.c:247-268, as _extend's functional right-padding), and a
+    right mirror/constant extension is a function of the signal's END —
+    which the LAST shard owns locally (halo <= shard is already
+    enforced). The last device swaps its ppermute wrap-around for its own
+    reversed tail (mirror) or broadcast edge sample (constant); zero
+    extra traffic. A left mirror/constant halo would genuinely need the
+    far shard and is rejected — no single-device op needs it.
 """
 
 from __future__ import annotations
@@ -29,7 +36,7 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
-BOUNDARIES = ("zero", "periodic")
+BOUNDARIES = ("zero", "periodic", "mirror", "constant")
 
 
 def halo_map(fn, mesh, axis="seq", *, left=0, right=0, boundary="zero",
@@ -51,6 +58,11 @@ def halo_map(fn, mesh, axis="seq", *, left=0, right=0, boundary="zero",
     """
     if boundary not in BOUNDARIES:
         raise ValueError(f"boundary must be one of {BOUNDARIES}")
+    if left and boundary in ("mirror", "constant"):
+        raise ValueError(
+            f"boundary={boundary!r} supports right halos only (the "
+            "extension contract is right-extension; a left "
+            "mirror/constant halo would need the far shard)")
     n_shards = mesh.shape[axis]
     fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
@@ -69,6 +81,14 @@ def halo_map(fn, mesh, axis="seq", *, left=0, right=0, boundary="zero",
             if boundary == "zero":
                 nxt = jnp.where(idx == n_shards - 1, jnp.zeros_like(nxt),
                                 nxt)
+            elif boundary == "mirror":
+                # global right-mirror tail x[n-1], x[n-2], ... lives
+                # entirely in the last shard (right <= shard)
+                tail = x_local[..., ::-1][..., :right]
+                nxt = jnp.where(idx == n_shards - 1, tail, nxt)
+            elif boundary == "constant":
+                edge = jnp.broadcast_to(x_local[..., -1:], nxt.shape)
+                nxt = jnp.where(idx == n_shards - 1, edge, nxt)
             parts.append(nxt)
         x_ext = jnp.concatenate(parts, axis=-1) if len(parts) > 1 else x_local
         return fn(x_ext, *args)

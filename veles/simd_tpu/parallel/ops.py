@@ -14,12 +14,17 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from veles.simd_tpu import wavelet_data
-from veles.simd_tpu.ops.wavelet import (EXTENSION_PERIODIC, EXTENSION_ZERO,
+from veles.simd_tpu.ops.wavelet import (EXTENSION_CONSTANT, EXTENSION_MIRROR,
+                                        EXTENSION_PERIODIC, EXTENSION_ZERO,
                                         _dwt_bank, _swt_bank)
 from veles.simd_tpu.parallel.alltoall import alltoall_map
 from veles.simd_tpu.parallel.halo import halo_map
 
-_SHARDABLE_EXT = {EXTENSION_PERIODIC: "periodic", EXTENSION_ZERO: "zero"}
+# All four extension modes of the boundary contract (initialize_extension,
+# src/wavelet.c:247-268) shard: the contract is right-extension, and the
+# right mirror/constant tails are local to the LAST shard (see halo_map).
+_SHARDABLE_EXT = {EXTENSION_PERIODIC: "periodic", EXTENSION_ZERO: "zero",
+                  EXTENSION_MIRROR: "mirror", EXTENSION_CONSTANT: "constant"}
 
 
 def convolve_sharded(x, h, mesh, axis="seq", *, boundary="zero"):
@@ -62,8 +67,8 @@ def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
 
     The right-extension of the single-device op (order samples past the
     shard end, src/wavelet.c:247-268) becomes the halo from the next
-    device; periodic/zero extensions only (mirror/constant need the far
-    ends — gather first).
+    device; all four extension modes shard (mirror/constant tails are
+    computed locally by the last shard — see halo_map's boundary policy).
     """
     boundary = _shardable(ext)
     x = jnp.asarray(x, jnp.float32)
@@ -116,8 +121,8 @@ def stationary_wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
 def _shardable(ext):
     if ext not in _SHARDABLE_EXT:
         raise ValueError(
-            f"extension {ext!r} is not shardable (periodic/zero only; "
-            "mirror/constant need the far signal ends)")
+            f"unknown extension type {ext!r}; one of "
+            f"{tuple(_SHARDABLE_EXT)}")
     return _SHARDABLE_EXT[ext]
 
 

@@ -36,6 +36,26 @@ import jax.numpy as jnp
 from veles.simd_tpu.parallel.halo import halo_map
 from veles.simd_tpu.shapes import overlap_save_fft_length
 
+# Below this block step the batched rfft stops amortizing on TPU (measured
+# ~14 MS/s at step ~512 vs ~2800 at 8192, ops/convolve.py policy table);
+# the auto-shrink warns rather than silently entering that regime.
+_STEP_FLOOR = 2048
+
+
+def _auto_length(m, shard):
+    """Default FFT block length for the sharded path.
+
+    Large shards take the single-device TPU policy (the 8192 block floor
+    of ops.convolve.os_block_length — small blocks leave the batched rfft
+    unamortized); shards too small for two such blocks keep the
+    reference's compact policy next_pow2(2*M) (convolve.c:115-118), which
+    is what fits.
+    """
+    compact = overlap_save_fft_length(m)
+    from veles.simd_tpu.ops.convolve import os_block_length
+    floor = os_block_length(m)
+    return floor if shard >= 2 * floor else compact
+
 
 def _windows(ext, step, overlap):
     """(..., shard + overlap) -> (..., n_blocks, step + overlap) windows at
@@ -122,16 +142,16 @@ def convolve_overlap_save_sharded(x, h, mesh, axis="seq", *,
     h = jnp.asarray(h, jnp.float32)
     m = h.shape[-1]
     overlap = m - 1
+    n_shards = mesh.shape[axis]
+    shard = x.shape[-1] // max(n_shards, 1)
     length = (fft_length if fft_length is not None
-              else overlap_save_fft_length(m))
+              else _auto_length(m, shard))
     if length < 2 * m - 1:
         raise ValueError(
             f"fft_length {length} < 2*M-1 = {2 * m - 1}: circular "
             "aliasing would corrupt every window")
     step = length - overlap
 
-    n_shards = mesh.shape[axis]
-    shard = x.shape[-1] // max(n_shards, 1)
     if shard % step != 0:
         if fft_length is not None:
             raise ValueError(
@@ -142,12 +162,28 @@ def convolve_overlap_save_sharded(x, h, mesh, axis="seq", *,
         # Auto policy: shrink the step so it divides the shard (largest
         # divisor still >= overlap), growing nothing — the rfft length is
         # re-derived from the chosen step.
+        policy_step = step
         step = next((s for s in range(min(step, shard), 0, -1)
                      if shard % s == 0 and s >= overlap), None)
         if step is None:
             raise ValueError(
                 f"no valid block step for shard length {shard} with "
                 f"overlap {overlap}; use convolve_sharded instead")
+        if policy_step >= _STEP_FLOOR and step < _STEP_FLOOR:
+            # A config whose policy step was in the fast regime got
+            # degraded by the divisor constraint into the ~14 MS/s
+            # tiny-rfft regime — degrading silently is worse than saying
+            # so. (Small-shard/small-filter configs whose policy step was
+            # already below the floor stay quiet: nothing was lost.)
+            import warnings
+            warnings.warn(
+                f"overlap-save auto-shrunk the block step to {step} "
+                f"(policy step {policy_step}, efficient floor "
+                f"{_STEP_FLOOR}): shard length {shard} has no larger "
+                f"divisor >= overlap {overlap}. Throughput will degrade; "
+                "pick a shard count (or signal length) making "
+                "shard % policy_step == 0, or pass fft_length explicitly.",
+                RuntimeWarning, stacklevel=2)
         length = step + overlap
 
     spectrum = jnp.fft.rfft(h, n=length)
