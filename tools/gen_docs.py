@@ -52,8 +52,10 @@ MODULES = [
     "veles.simd_tpu.parallel.overlap_save",
     "veles.simd_tpu.parallel.ops",
     "veles.simd_tpu.parallel.multihost",
+    "veles.simd_tpu.pallas.convolve",
     "veles.simd_tpu.pallas.elementwise",
     "veles.simd_tpu.pallas.matmul",
+    "veles.simd_tpu.pallas.normalize",
     "veles.simd_tpu.pallas.wavelet",
     "veles.simd_tpu.utils.benchlib",
     "veles.simd_tpu.utils.checkpoint",

@@ -1,0 +1,122 @@
+"""Fast on-chip validation of the hand-kernel surface.
+
+One pass, small shapes, real TPU: every Pallas kernel lowered through
+Mosaic (not interpret mode) plus the bench-critical paths. Run this
+FIRST when chip access returns after CPU-side kernel work — interpret
+mode validates semantics, not lowerability (element-indexed block dims,
+scratch shapes, and dimension semantics can all pass on CPU and still be
+rejected or miscompiled by Mosaic).
+
+Exit code 0 and a final "ALL OK" line mean the full test suite and bench
+are worth their longer runtimes.
+
+Run:  python tools/tpu_smoke.py
+"""
+
+import sys
+
+import numpy as np
+
+
+def check(name, fn):
+    import traceback
+    try:
+        fn()
+        print(f"  ok  {name}")
+        return True
+    except Exception:
+        print(f"FAIL  {name}")
+        traceback.print_exc(limit=3)
+        return False
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend(), jax.devices())
+    rng = np.random.default_rng(0)
+    results = []
+
+    def matmul():
+        from veles.simd_tpu import ops
+        a = jnp.asarray(rng.normal(size=(512, 512)).astype(np.float32))
+        got = np.asarray(ops.matrix_multiply(a, a, impl="pallas"))
+        want = np.asarray(ops.matrix_multiply(a, a, impl="xla"))
+        np.testing.assert_allclose(got, want, atol=0.5, rtol=0.05)
+
+    def dwt():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=(3, 4096)).astype(np.float32)
+        hi_p, lo_p = ops.wavelet_apply(x, "daubechies", 8, impl="pallas")
+        hi_x, lo_x = ops.wavelet_apply(x, "daubechies", 8, impl="xla")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=5e-4)
+        np.testing.assert_allclose(np.asarray(lo_p), np.asarray(lo_x),
+                                   atol=5e-4)
+
+    def dwt_multiblock():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=4 * 1024 * 1024).astype(np.float32)
+        hi_p, lo_p = ops.wavelet_apply(x, "daubechies", 8, impl="pallas")
+        hi_x, lo_x = ops.wavelet_apply(x, "daubechies", 8, impl="xla")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=5e-4)
+
+    def swt():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=(2, 8192)).astype(np.float32)
+        hi_p, lo_p = ops.stationary_wavelet_apply(
+            x, "daubechies", 8, 3, impl="pallas")
+        hi_x, lo_x = ops.stationary_wavelet_apply(
+            x, "daubechies", 8, 3, impl="xla")
+        np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),
+                                   atol=5e-4)
+
+    def conv_direct():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=(2, 4096)).astype(np.float32)
+        h = rng.normal(size=63).astype(np.float32)
+        got = np.asarray(ops.convolve(x, h, algorithm="direct",
+                                      impl="pallas"))
+        want = np.asarray(ops.convolve(x, h, algorithm="direct"))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+    def norm():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=(8, 65536)).astype(np.float32)
+        got = np.asarray(ops.normalize1D(x, impl="pallas"))
+        want = np.asarray(ops.normalize1D(x, impl="xla"))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def cephes():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=100000).astype(np.float32)
+        got = np.asarray(ops.sin_psv(x, impl="pallas"))
+        np.testing.assert_allclose(got, np.sin(x), atol=1e-5)
+
+    def elementwise():
+        from veles.simd_tpu import ops
+        x = rng.normal(size=65536).astype(np.float32)
+        got = np.asarray(ops.real_multiply_scalar(x, 2.5, impl="pallas"))
+        np.testing.assert_allclose(got, x * 2.5, rtol=1e-6)
+
+    for name, fn in [("pallas matmul (bf16 blocks)", matmul),
+                     ("pallas DWT gridded+batched", dwt),
+                     ("pallas DWT 4M multi-block", dwt_multiblock),
+                     ("pallas SWT dilated", swt),
+                     ("pallas direct convolve", conv_direct),
+                     ("pallas minmax/normalize", norm),
+                     ("pallas cephes sin", cephes),
+                     ("pallas elementwise", elementwise)]:
+        results.append(check(name, fn))
+
+    if all(results):
+        print("ALL OK")
+        return 0
+    print(f"{results.count(False)} FAILED")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
