@@ -149,6 +149,10 @@ def supervise(headline_only_run: bool = False) -> int:
     for headline_only, timeout_s, sleep_s in plans:
         if hung and not headline_only:
             continue  # tunnel hangs: don't repeat a full-length attempt
+        if hung:
+            # a dead tunnel hangs every attempt; keep the final try short
+            # so the error JSON lands inside the driver's own timeout
+            timeout_s = min(timeout_s, 300)
         if sleep_s:
             time.sleep(sleep_s)
         cmd = [sys.executable, os.path.abspath(__file__), "--worker"]

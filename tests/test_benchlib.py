@@ -78,3 +78,36 @@ def test_feed_io_config_smoke():
     out = bench_feed_io(scale=1 / 64)
     assert out["unit"] == "MSamples/s"
     assert math.isfinite(out["value"]) and out["value"] > 0
+
+
+def test_chain_stats_keys_and_ordering():
+    """chain_stats returns corrected/raw/floor per config with
+    raw >= corrected (the raw wall-clock is the unimpeachable bound)."""
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    carry = jnp.ones((64, 64), jnp.float32)
+    sts = chain_stats({"mm": lambda c: c @ c * 1e-3}, carry,
+                      iters=16, reps=2, on_floor="nan")
+    st = sts["mm"]
+    assert set(st) == {"sec", "raw_sec", "floor_sec"}
+    assert st["raw_sec"] > 0 and st["floor_sec"] > 0
+    if math.isfinite(st["sec"]):
+        assert st["raw_sec"] >= st["sec"]
+
+
+def test_bench_collect_secondary_shape(monkeypatch):
+    """collect_secondary returns {metric: record}; a raising config
+    contributes an error record without killing the rest."""
+    from veles.simd_tpu.utils import bench_extra
+
+    def boom(scale=1):
+        raise RuntimeError("nope")
+
+    def tiny(scale=1):
+        return {"metric": "tiny", "value": 1.0, "unit": "x",
+                "vs_baseline": None}
+
+    monkeypatch.setattr(bench_extra, "CONFIGS", (tiny, boom))
+    out = bench_extra.collect_secondary(scale=1)
+    assert out["tiny"]["value"] == 1.0
+    assert "error" in out["boom"]

@@ -117,7 +117,7 @@ def render_member(name, obj):
         obj = wrapped  # render jit wrappers as what they wrap
     if inspect.isfunction(obj):
         try:
-            sig = str(inspect.signature(obj))
+            sig = _strip_addr(str(inspect.signature(obj)))
         except (ValueError, TypeError):
             sig = "(...)"
         out.append(f"### `{name}{sig}`\n")
@@ -133,7 +133,7 @@ def render_member(name, obj):
             if mname.startswith("_"):
                 continue
             try:
-                sig = str(inspect.signature(meth))
+                sig = _strip_addr(str(inspect.signature(meth)))
             except (ValueError, TypeError):
                 sig = "(...)"
             mdoc = inspect.getdoc(meth)
@@ -146,6 +146,12 @@ def render_member(name, obj):
             rep = rep[:117] + "..."
         out.append(f"### `{name}` = `{rep}`\n")
     return "\n".join(out)
+
+
+def _strip_addr(s):
+    """Drop `at 0x...` memory addresses (function-object defaults in
+    signatures would otherwise churn the checked-in docs every regen)."""
+    return re.sub(r" at 0x[0-9a-f]+", "", s)
 
 
 def _stable_repr(obj):
