@@ -1,0 +1,131 @@
+"""Polyphase resampling suite (framework extension; no reference-C
+analogue — the oracle is the float64 zero-stuff definition, cross-checked
+against scipy.signal.upfirdn where available)."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.reference import resample as ref_resample
+
+
+class TestUpfirdn:
+    @pytest.mark.parametrize("up,down", [(1, 1), (2, 1), (1, 2), (3, 2),
+                                         (2, 3), (4, 4), (5, 3), (7, 4)])
+    @pytest.mark.parametrize("n,m", [(64, 9), (130, 31), (257, 16)])
+    def test_differential(self, rng, up, down, n, m):
+        x = rng.normal(size=n).astype(np.float32)
+        h = rng.normal(size=m).astype(np.float32)
+        want = ref_resample.upfirdn(x, h, up, down)
+        got = np.asarray(ops.upfirdn(x, h, up, down))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_matches_scipy(self, rng):
+        scipy_signal = pytest.importorskip("scipy.signal")
+        x = rng.normal(size=100).astype(np.float64)
+        h = rng.normal(size=21).astype(np.float64)
+        want = scipy_signal.upfirdn(h, x, up=3, down=2)
+        got = ref_resample.upfirdn(x, h, 3, 2)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_identity_is_convolution(self, rng):
+        x = rng.normal(size=100).astype(np.float32)
+        h = rng.normal(size=15).astype(np.float32)
+        got = np.asarray(ops.upfirdn(x, h, 1, 1))
+        want = np.asarray(ops.convolve(x, h, algorithm="direct"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_batched(self, rng):
+        batch = rng.normal(size=(3, 4, 96)).astype(np.float32)
+        h = rng.normal(size=13).astype(np.float32)
+        got = np.asarray(ops.upfirdn(batch, h, 3, 2))
+        want = ref_resample.upfirdn(batch, h, 3, 2)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_bad_factors(self):
+        with pytest.raises(ValueError):
+            ops.upfirdn(np.zeros(8, np.float32), np.ones(3, np.float32),
+                        up=0)
+
+
+class TestResamplePoly:
+    @pytest.mark.parametrize("up,down", [(2, 1), (1, 2), (3, 2), (2, 3),
+                                         (160, 147)])
+    def test_length_and_oracle(self, rng, up, down):
+        n = 441
+        x = rng.normal(size=n).astype(np.float32)
+        h = ops.resample_filter(up, down, taps_per_phase=4)
+        want = ref_resample.resample_poly(x, up, down, h)
+        got = np.asarray(ops.resample_poly(x, up, down, h))
+        assert got.shape[-1] == -(-n * up // down)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_sine_preserved(self, rng):
+        # a tone well below both Nyquists survives 3/2 resampling with
+        # the same amplitude and the exact t*down/up time alignment
+        n, up, down = 2048, 3, 2
+        t = np.arange(n, dtype=np.float64)
+        x = np.sin(2 * np.pi * 0.01 * t).astype(np.float32)
+        y = np.asarray(ops.resample_poly(x, up, down))
+        t_out = np.arange(y.shape[-1], dtype=np.float64) * down / up
+        want = np.sin(2 * np.pi * 0.01 * t_out)
+        # ignore filter-length edge transients on both ends
+        edge = 64
+        np.testing.assert_allclose(y[edge:-edge], want[edge:-edge],
+                                   atol=5e-3)
+
+    def test_default_filter_dc_gain(self):
+        # unity DC gain after upsampling: a constant resamples to itself
+        x = np.ones(512, np.float32)
+        y = np.asarray(ops.resample_poly(x, 2, 1))
+        mid = y[100:-100]
+        np.testing.assert_allclose(mid, np.ones_like(mid), atol=1e-3)
+
+    def test_batched(self, rng):
+        batch = rng.normal(size=(5, 200)).astype(np.float32)
+        h = ops.resample_filter(2, 3, taps_per_phase=4)
+        got = np.asarray(ops.resample_poly(batch, 2, 3, h))
+        want = ref_resample.resample_poly(batch, 2, 3, h)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+class TestResampleStream:
+    """Streaming upfirdn: chunk-concat equals the whole-signal causal
+    body exactly (the framework's streaming exactness contract)."""
+
+    @pytest.mark.parametrize("up,down,chunk", [(1, 1, 64), (2, 1, 64),
+                                               (1, 2, 64), (3, 2, 64),
+                                               (2, 3, 96), (5, 4, 80)])
+    def test_concat_matches_whole(self, rng, up, down, chunk):
+        n = chunk * 6
+        x = rng.normal(size=n).astype(np.float32)
+        h = rng.normal(size=23).astype(np.float32)
+        st = ops.resample_stream_init(h, up, down)
+        outs = []
+        for i in range(0, n, chunk):
+            st, y = ops.resample_stream_step(st, x[i:i + chunk], h,
+                                             up=up, down=down)
+            outs.append(np.asarray(y))
+        got = np.concatenate(outs)
+        want = np.asarray(ops.upfirdn(x, h, up, down))[:n * up // down]
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(3, 128)).astype(np.float32)
+        h = rng.normal(size=11).astype(np.float32)
+        st = ops.resample_stream_init(h, 3, 2, batch_shape=(3,))
+        st, y1 = ops.resample_stream_step(st, x[:, :64], h, up=3, down=2)
+        st, y2 = ops.resample_stream_step(st, x[:, 64:], h, up=3, down=2)
+        got = np.concatenate([np.asarray(y1), np.asarray(y2)], axis=-1)
+        want = np.asarray(ops.upfirdn(x, h, 3, 2))[..., :128 * 3 // 2]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_chunk_constraint(self):
+        h = np.ones(5, np.float32)
+        st = ops.resample_stream_init(h, 2, 3)
+        with pytest.raises(ValueError, match="divisible"):
+            ops.resample_stream_step(st, np.zeros(64, np.float32), h,
+                                     up=2, down=3)

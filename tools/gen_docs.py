@@ -26,6 +26,7 @@ MODULES = [
     "veles.simd_tpu.ops.convolve",
     "veles.simd_tpu.ops.correlate",
     "veles.simd_tpu.ops.normalize",
+    "veles.simd_tpu.ops.resample",
     "veles.simd_tpu.ops.detect_peaks",
     "veles.simd_tpu.ops.wavelet",
     "veles.simd_tpu.ops.stream",

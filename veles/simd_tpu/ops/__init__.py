@@ -37,14 +37,17 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate_fft, cross_correlate_finalize,
     cross_correlate_initialize, cross_correlate_overlap_save,
     cross_correlate_simd)
+from veles.simd_tpu.ops.resample import (  # noqa: F401
+    resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     frame, hann_window, istft, overlap_add, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
-    StftStreamState, SwtStreamReconState, SwtStreamState, fir_stream_init,
-    fir_stream_step, istft_stream_init, istft_stream_step,
-    minmax_stream_init, minmax_stream_step, peaks_stream_init,
-    peaks_stream_step, stft_stream_init, stft_stream_step,
+    ResampleStreamState, StftStreamState, SwtStreamReconState,
+    SwtStreamState, fir_stream_init, fir_stream_step, istft_stream_init,
+    istft_stream_step, minmax_stream_init, minmax_stream_step,
+    peaks_stream_init, peaks_stream_step, resample_stream_init,
+    resample_stream_step, stft_stream_init, stft_stream_step,
     stft_stream_warmup, stream_scan, swt_stream_delay, swt_stream_init,
     swt_stream_reconstruct_init, swt_stream_reconstruct_step,
     swt_stream_step)

@@ -104,6 +104,71 @@ def fir_stream_step(state: FirStreamState, chunk, h):
 
 
 # ---------------------------------------------------------------------------
+# streaming polyphase resampler
+# ---------------------------------------------------------------------------
+
+class ResampleStreamState(NamedTuple):
+    """Carry for streaming upfirdn: the last ``ceil(m/up) - 1`` input
+    samples (the phase filters' reach at input rate)."""
+    tail: jax.Array
+
+
+def resample_stream_init(h, up=1, down=1,
+                         batch_shape=()) -> ResampleStreamState:
+    """Start-of-stream state (zero history — causal alignment, matching
+    ``upfirdn``'s leading output samples)."""
+    if up < 1 or down < 1:
+        raise ValueError("up and down must be >= 1")
+    m = jnp.shape(h)[-1]
+    lp = -(-m // up)
+    return ResampleStreamState(
+        jnp.zeros((*batch_shape, lp - 1), jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("up", "down"))
+def resample_stream_step(state: ResampleStreamState, chunk, h, up=1,
+                         down=1):
+    """Resample one chunk -> (state', y), y length chunk*up/down.
+
+    Chunk constraint: ``(chunk_length * up) % down == 0`` — each step
+    must emit a whole number of output samples so shapes stay static
+    under jit (pick chunk lengths as multiples of down/gcd(up, down)).
+    Concatenating successive ``y`` equals the leading
+    ``total*up/down`` samples of ``ops.upfirdn`` on the concatenated
+    input (the causal body; the filter tail past the final input sample
+    is never emitted — feed zeros to flush it).
+
+    The kernel is the same zero-stuff-free polyphase form as
+    ops/resample.py: per-phase VALID correlations over the carry-extended
+    block, phases interleaved at the up rate, then the ``down`` stride.
+    """
+    chunk = jnp.asarray(chunk, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    m = h.shape[-1]
+    lp = -(-m // up)
+    n = chunk.shape[-1]
+    if (n * up) % down != 0:
+        raise ValueError(
+            f"chunk length {n} * up {up} must be divisible by down "
+            f"{down} so each step emits whole output samples")
+    if state.tail.shape[-1] != lp - 1:
+        raise ValueError(
+            f"state tail length {state.tail.shape[-1]} != ceil(m/up)-1 "
+            f"= {lp - 1}; init and step must agree on (h, up)")
+    _check_stream_batch(state.tail, chunk, "resample_stream_init")
+    from veles.simd_tpu.ops.resample import (_phase_bank_interleave,
+                                             _phase_split)
+    z = jnp.concatenate([state.tail, chunk], axis=-1)  # (..., lp-1 + n)
+    # causal output at global input index q needs x[q-r], r <= lp-1 —
+    # all inside the carry-extended block; the kernel is the SAME
+    # polyphase bank as the whole-signal op (exactness by construction)
+    y_up = _phase_bank_interleave(z, _phase_split(h, up, m), n)
+    y = y_up[..., ::down]
+    new_tail = z[..., z.shape[-1] - (lp - 1):]
+    return ResampleStreamState(new_tail), y
+
+
+# ---------------------------------------------------------------------------
 # running minmax
 # ---------------------------------------------------------------------------
 

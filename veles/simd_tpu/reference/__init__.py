@@ -14,5 +14,6 @@ from veles.simd_tpu.reference import detect_peaks  # noqa: F401
 from veles.simd_tpu.reference import mathfun  # noqa: F401
 from veles.simd_tpu.reference import matrix  # noqa: F401
 from veles.simd_tpu.reference import normalize  # noqa: F401
+from veles.simd_tpu.reference import resample  # noqa: F401
 from veles.simd_tpu.reference import spectral  # noqa: F401
 from veles.simd_tpu.reference import wavelet  # noqa: F401

@@ -1,0 +1,42 @@
+"""Oracle for polyphase resampling (upfirdn / resample_poly).
+
+The definition itself, in float64: zero-stuff by ``up``, filter with
+``h`` (full linear convolution), downsample by ``down``. No reference-C
+analogue (the reference library stops at convolution); the framework
+extension composes its own convolve machinery, and this oracle pins it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def upfirdn(x, h, up=1, down=1):
+    x = np.asarray(x, dtype=np.float64)
+    h = np.asarray(h, dtype=np.float64)
+    if up < 1 or down < 1:
+        raise ValueError("up and down must be >= 1")
+    n = x.shape[-1]
+    stuffed = np.zeros(x.shape[:-1] + ((n - 1) * up + 1,), np.float64)
+    stuffed[..., ::up] = x
+    full = np.apply_along_axis(lambda r: np.convolve(r, h, mode="full"),
+                               -1, stuffed)
+    return full[..., ::down]
+
+
+def resample_poly(x, up, down, h):
+    """Rational-rate resampler given an explicit FIR ``h``: the filter's
+    group delay (m-1)/2 is trimmed at the UP rate before downsampling,
+    so output sample t sits at input time t * down / up exactly; output
+    length ceil(n * up / down)."""
+    x = np.asarray(x, dtype=np.float64)
+    n = x.shape[-1]
+    m = np.asarray(h).shape[-1]
+    out_len = -(-n * up // down)
+    full_up = upfirdn(x, h, up, 1)
+    sliced = full_up[..., (m - 1) // 2::down]
+    sliced = sliced[..., :out_len]
+    if sliced.shape[-1] < out_len:  # filter shorter than the rate step
+        pad = [(0, 0)] * (sliced.ndim - 1) + [(0, out_len - sliced.shape[-1])]
+        sliced = np.pad(sliced, pad)
+    return sliced

@@ -143,9 +143,9 @@ def chain_time(step_fn, carry, iters: int, reps: int = 3, *,
 
 
 def chain_stat(step_fn, carry, iters: int, reps: int = 3, *,
-               null_carry=None, attempts: int = 1,
+               on_floor: str = "raise", null_carry=None, attempts: int = 1,
                attempt_gap_s: float = 0.0) -> dict:
     """Single-config convenience wrapper over chain_stats."""
     return chain_stats({"_": step_fn}, carry, iters, reps,
-                       null_carry=null_carry, attempts=attempts,
-                       attempt_gap_s=attempt_gap_s)["_"]
+                       on_floor=on_floor, null_carry=null_carry,
+                       attempts=attempts, attempt_gap_s=attempt_gap_s)["_"]
