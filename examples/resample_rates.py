@@ -52,9 +52,10 @@ def main():
         outs.append(np.asarray(yc))
     y_stream = np.concatenate(outs)
     whole = np.asarray(ops.upfirdn(x[:(n // chunk) * chunk], h, up, down))
-    match = np.allclose(y_stream, whole[:y_stream.shape[-1]], atol=1e-4)
+    # same kernel, same accumulation order: exact equality, not allclose
+    match = np.array_equal(y_stream, whole[:y_stream.shape[-1]])
     print(f"streaming ({chunk}-sample chunks -> {up} out each): "
-          f"concat == whole-signal: {match}")
+          f"concat == whole-signal bit-exact: {match}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; when it answers, run the full on-chip
+# validation + measurement sequence and log everything. Detach with:
+#   nohup bash tools/await_tpu.sh > /tmp/tpu_watch.log 2>&1 &
+# Outputs land under /tmp (kept out of the repo):
+#   /tmp/tpu_watch.log        - progress + summaries
+#   /tmp/tpu_suite.log        - full VELES_TEST_TPU pytest output
+#   /tmp/tune_matmul.log      - tile sweep table
+#   /tmp/bench_preview.json   - bench.py stdout (the driver-format line)
+set -u
+cd /root/repo
+
+echo "[watch] start $(date -u +%H:%M:%S)"
+while true; do
+  if timeout 150 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
+    break
+  fi
+  echo "[watch] tunnel down $(date -u +%H:%M:%S)"
+  sleep 45
+done
+echo "[watch] TPU UP at $(date -u +%H:%M:%S)"
+
+echo "[watch] === tpu_smoke ==="
+timeout 1800 python tools/tpu_smoke.py 2>&1 | tail -15
+
+echo "[watch] === VELES_TEST_TPU suite ==="
+timeout 3600 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
+  > /tmp/tpu_suite.log 2>&1
+tail -3 /tmp/tpu_suite.log
+
+echo "[watch] === tune_matmul sweep ==="
+timeout 2400 python tools/tune_matmul.py > /tmp/tune_matmul.log 2>&1
+tail -25 /tmp/tune_matmul.log
+
+echo "[watch] === bench.py ==="
+timeout 2400 python bench.py > /tmp/bench_preview.json 2>/tmp/bench_err.log
+cat /tmp/bench_preview.json
+
+echo "[watch] DONE $(date -u +%H:%M:%S)"

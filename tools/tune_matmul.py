@@ -19,7 +19,6 @@ import numpy as np
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from veles.simd_tpu.pallas.matmul import matmul
     from veles.simd_tpu.utils.benchlib import chain_stats

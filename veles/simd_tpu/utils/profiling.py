@@ -83,6 +83,18 @@ def convolve_overlap_save_flops(x_len: int, h_len: int,
     return fft_flops(block) + n_blocks * per_block  # + one H transform
 
 
+def upfirdn_flops(n: int, m: int, up: int, down: int) -> int:
+    """Polyphase upfirdn as implemented (ops/resample.py): every up-rate
+    sample costs ceil(m/up) taps (zero-stuff-free), and the ``down``
+    decimation happens AFTER the bank — so executed work is independent
+    of ``down``. (A down-phase-selective bank would divide this by
+    ~down; that optimization is not implemented, and this model tracks
+    the code, not the ideal.)"""
+    lp = -(-m // up)
+    q_len = n + lp - 1
+    return 2 * lp * up * q_len
+
+
 def wavelet_flops(n: int, order: int, *, stationary: bool = False,
                   levels: int = 1) -> int:
     """DWT: hi+lo filter bank, n/2 outputs each per level, halving n;
