@@ -302,3 +302,62 @@ class TestWaveletFuzz:
                                               impl="xla")
         np.testing.assert_allclose(np.asarray(xh), rh, atol=5e-4)
         np.testing.assert_allclose(np.asarray(xl), rl, atol=5e-4)
+
+
+class TestWavelet2D:
+    """Separable 2-D DWT (beyond-parity; the oracle composes the 1-D
+    float64 oracle along both axes)."""
+
+    @pytest.mark.parametrize("ext", ref_wavelet.EXTENSION_TYPES)
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_differential(self, rng, ext, impl):
+        img = rng.normal(size=(16, 24)).astype(np.float32)
+        want = ref_wavelet.wavelet_apply2D(img, "daubechies", 4, ext)
+        got = W.wavelet_apply2D(img, "daubechies", 4, ext, impl=impl)
+        for g, w_ in zip(got, want):
+            assert g.shape == (8, 12)
+            np.testing.assert_allclose(np.asarray(g), w_, atol=5e-4)
+
+    def test_batched(self, rng):
+        imgs = rng.normal(size=(3, 16, 16)).astype(np.float32)
+        ll, lh, hl, hh = W.wavelet_apply2D(imgs, "daubechies", 8)
+        assert ll.shape == (3, 8, 8)
+        want = ref_wavelet.wavelet_apply2D(imgs[1], "daubechies", 8,
+                                           "periodic")
+        np.testing.assert_allclose(np.asarray(ll[1]), want[0], atol=5e-4)
+        np.testing.assert_allclose(np.asarray(hh[1]), want[3], atol=5e-4)
+
+    @pytest.mark.parametrize("wavelet_type,order",
+                             [("daubechies", 8), ("symlet", 4),
+                              ("coiflet", 6)])
+    def test_perfect_reconstruction(self, rng, wavelet_type, order):
+        img = rng.normal(size=(32, 32)).astype(np.float32)
+        bands = W.wavelet_apply2D(img, wavelet_type, order, "periodic")
+        back = W.wavelet_reconstruct2D(*bands, wavelet_type, order,
+                                       "periodic")
+        np.testing.assert_allclose(np.asarray(back), img, atol=2e-4)
+
+    def test_pyramid_roundtrip(self, rng):
+        img = rng.normal(size=(2, 64, 48)).astype(np.float32)
+        details, ll = W.wavelet_decompose2D(img, 3, "daubechies", 4,
+                                            "periodic")
+        assert ll.shape == (2, 8, 6)
+        assert [d[0].shape[-2:] for d in details] == \
+            [(32, 24), (16, 12), (8, 6)]
+        back = W.wavelet_recompose2D(details, ll, "daubechies", 4,
+                                     "periodic")
+        np.testing.assert_allclose(np.asarray(back), img, atol=5e-4)
+
+    def test_energy_preserved(self, rng):
+        # orthogonal transform: sum of band energies == image energy
+        img = rng.normal(size=(32, 32)).astype(np.float32)
+        bands = W.wavelet_apply2D(img, "daubechies", 8, "periodic")
+        got = sum(float(np.sum(np.asarray(b) ** 2)) for b in bands)
+        np.testing.assert_allclose(got, float(np.sum(img * img)),
+                                   rtol=1e-4)
+
+    def test_shape_contracts(self):
+        with pytest.raises(ValueError):
+            W.wavelet_apply2D(np.zeros(16, np.float32))
+        with pytest.raises(ValueError):
+            W.wavelet_decompose2D(np.zeros((12, 16), np.float32), 3)

@@ -28,11 +28,13 @@ from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,
     stationary_wavelet_recompose, stationary_wavelet_reconstruct,
     shannon_cost, wavelet_allocate_destination, wavelet_apply,
-    wavelet_decompose, wavelet_packet_best_basis,
+    wavelet_apply2D, wavelet_decompose, wavelet_decompose2D,
+    wavelet_packet_best_basis,
     wavelet_packet_decompose, wavelet_packet_reconstruct,
     wavelet_packet_reconstruct_basis, wavelet_packet_tree,
-    wavelet_prepare_array, wavelet_recompose, wavelet_reconstruct,
-    wavelet_recycle_source, wavelet_validate_order)
+    wavelet_prepare_array, wavelet_recompose, wavelet_recompose2D,
+    wavelet_reconstruct, wavelet_reconstruct2D, wavelet_recycle_source,
+    wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate_fft, cross_correlate_finalize,
     cross_correlate_initialize, cross_correlate_overlap_save,

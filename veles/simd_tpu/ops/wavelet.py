@@ -400,6 +400,88 @@ def stationary_wavelet_decompose(src, levels, wavelet_type="daubechies",
     return details, lo
 
 
+# ---------------------------------------------------------------------------
+# separable 2-D transform (beyond-parity: the reference's only 2-D ops
+# are normalize2D/minmax2D; images are the natural next surface for the
+# same filter banks)
+# ---------------------------------------------------------------------------
+
+def _t(a):
+    return jnp.swapaxes(jnp.asarray(a), -1, -2)
+
+
+def wavelet_apply2D(src, wavelet_type="daubechies", order=8,
+                    ext=EXTENSION_PERIODIC, *, impl=None):
+    """Separable 2-D DWT step: (..., H, W) -> (ll, lh, hl, hh), each
+    (..., H/2, W/2).
+
+    The 1-D bank runs along W (each row; leading axes including H ride
+    the batch path), then along H via a transpose. Band naming: first
+    letter = the H-axis filter, second = the W-axis filter (l = lowpass,
+    h = highpass) — ``lh`` is lowpass down columns of the row-highpass
+    plane. Both H and W must be even. The transposes are XLA relayouts;
+    the filter math stays in the batch-native banks (_dwt_bank).
+    """
+    if np.ndim(src) < 2:
+        raise ValueError(f"need (..., H, W); got shape {np.shape(src)}")
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _ref.wavelet_apply2D(src, wavelet_type, order, ext)
+    src = jnp.asarray(src, jnp.float32)
+    hi_w, lo_w = wavelet_apply(src, wavelet_type, order, ext, impl=impl)
+    hh, lh = (_t(b) for b in wavelet_apply(_t(hi_w), wavelet_type, order,
+                                           ext, impl=impl))
+    hl, ll = (_t(b) for b in wavelet_apply(_t(lo_w), wavelet_type, order,
+                                           ext, impl=impl))
+    return ll, lh, hl, hh
+
+
+def wavelet_reconstruct2D(ll, lh, hl, hh, wavelet_type="daubechies",
+                          order=8, ext=EXTENSION_PERIODIC, *, impl=None):
+    """Inverse separable 2-D DWT step (periodic only, like the 1-D
+    inverse): four (..., H/2, W/2) bands -> (..., H, W)."""
+    lo_w = _t(wavelet_reconstruct(_t(hl), _t(ll), wavelet_type, order,
+                                  ext, impl=impl))
+    hi_w = _t(wavelet_reconstruct(_t(hh), _t(lh), wavelet_type, order,
+                                  ext, impl=impl))
+    return wavelet_reconstruct(hi_w, lo_w, wavelet_type, order, ext,
+                               impl=impl)
+
+
+def wavelet_decompose2D(src, levels, wavelet_type="daubechies", order=8,
+                        ext=EXTENSION_PERIODIC, *, impl=None):
+    """Multi-level 2-D pyramid: cascade on the ll band. Returns
+    (details, approx) with details[k] = (lh, hl, hh) at level k+1
+    (shapes H/2^(k+1) x W/2^(k+1)); both H and W must be divisible by
+    2^levels."""
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    shape = jnp.asarray(src).shape
+    if len(shape) < 2:
+        raise ValueError(f"need (..., H, W); got shape {shape}")
+    if shape[-1] % (1 << levels) or shape[-2] % (1 << levels):
+        raise ValueError(
+            f"H, W = {shape[-2:]} must be divisible by 2^levels "
+            f"= {1 << levels}")
+    details = []
+    ll = src
+    for _ in range(levels):
+        ll, lh, hl, hh = wavelet_apply2D(ll, wavelet_type, order, ext,
+                                         impl=impl)
+        details.append((lh, hl, hh))
+    return details, ll
+
+
+def wavelet_recompose2D(details, approx, wavelet_type="daubechies",
+                        order=8, ext=EXTENSION_PERIODIC, *, impl=None):
+    """Inverse of wavelet_decompose2D (periodic only)."""
+    ll = approx
+    for lh, hl, hh in reversed(details):
+        ll = wavelet_reconstruct2D(ll, lh, hl, hh, wavelet_type, order,
+                                   ext, impl=impl)
+    return ll
+
+
 def wavelet_packet_decompose(src, levels, wavelet_type="daubechies",
                              order=8, ext=EXTENSION_PERIODIC, *,
                              impl=None):

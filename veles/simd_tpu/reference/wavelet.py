@@ -150,3 +150,30 @@ def stationary_wavelet_reconstruct(desthi, destlo, wavelet_type="daubechies",
         idx = (m - stride * j) % n
         out = out + lo_f[j] * lo[..., idx] + hi_f[j] * hi[..., idx]
     return out * gain
+
+
+def wavelet_apply2D(src, wavelet_type="daubechies", order=8,
+                    ext=EXTENSION_PERIODIC):
+    """Separable 2-D DWT oracle: the 1-D transform along the last axis
+    (W), then along the second-to-last (H). Returns (ll, lh, hl, hh),
+    each (..., H/2, W/2); the first band letter is the H-axis filter,
+    the second the W-axis filter."""
+    src = np.asarray(src, dtype=np.float64)
+
+    def along_w(a):
+        hi = np.empty(a.shape[:-1] + (a.shape[-1] // 2,))
+        lo = np.empty_like(hi)
+        flat = a.reshape(-1, a.shape[-1])
+        fh = hi.reshape(-1, hi.shape[-1])
+        fl = lo.reshape(-1, lo.shape[-1])
+        for i in range(flat.shape[0]):
+            fh[i], fl[i] = wavelet_apply(flat[i], wavelet_type, order, ext)
+        return hi, lo
+
+    def t(a):
+        return np.swapaxes(a, -1, -2)
+
+    hi_w, lo_w = along_w(src)
+    hh, lh = (t(b) for b in along_w(t(hi_w)))
+    hl, ll = (t(b) for b in along_w(t(lo_w)))
+    return ll, lh, hl, hh
