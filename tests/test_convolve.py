@@ -233,3 +233,62 @@ class TestPallasDirect:
         got = np.asarray(ops.cross_correlate(x, h, algorithm="direct",
                                              impl="pallas"))
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
+
+
+class TestConvolve2D:
+    """2-D convolution (beyond-parity; oracle = scipy convolve2d in
+    float64 via reference/convolve.py)."""
+
+    @pytest.mark.parametrize("algorithm", ["direct", "fft"])
+    @pytest.mark.parametrize("shape,kern", [((16, 24), (3, 5)),
+                                            ((33, 17), (7, 7)),
+                                            ((64, 64), (5, 3))])
+    def test_differential(self, rng, algorithm, shape, kern):
+        x = rng.normal(size=shape).astype(np.float32)
+        h = rng.normal(size=kern).astype(np.float32)
+        want = ops.convolve2D(x, h, impl="reference")
+        got = np.asarray(ops.convolve2D(x, h, algorithm=algorithm))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_selector_picks_fft_for_big_kernels(self, rng):
+        x = rng.normal(size=(64, 64)).astype(np.float32)
+        h = rng.normal(size=(17, 17)).astype(np.float32)  # 289 > 192 taps
+        want = ops.convolve2D(x, h, impl="reference")
+        got = np.asarray(ops.convolve2D(x, h))  # auto -> fft
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=5e-3)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(2, 3, 20, 28)).astype(np.float32)
+        h = rng.normal(size=(3, 3)).astype(np.float32)
+        got = np.asarray(ops.convolve2D(x, h))
+        want = ops.convolve2D(x, h, impl="reference")
+        assert got.shape == (2, 3, 22, 30)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_separable_matches_outer_kernel(self, rng):
+        x = rng.normal(size=(24, 24)).astype(np.float32)
+        hr = rng.normal(size=5).astype(np.float32)
+        hc = rng.normal(size=7).astype(np.float32)
+        got = np.asarray(ops.convolve2D_separable(x, hr, hc))
+        want = np.asarray(ops.convolve2D(x, np.outer(hc, hr)))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+    def test_direct_tap_cap(self, rng):
+        x = np.zeros((32, 32), np.float32)
+        h = np.zeros((32, 32), np.float32)  # 1024 > 512 taps
+        with pytest.raises(ValueError, match="caps at"):
+            ops.convolve2D(x, h, algorithm="direct")
+
+    def test_shape_contracts(self):
+        with pytest.raises(ValueError):
+            ops.convolve2D(np.zeros(16, np.float32),
+                           np.zeros((3, 3), np.float32))
+
+
+def test_separable_rejects_2d_taps():
+    # a (k, 1) column vector would silently broadcast to 1 tap
+    with pytest.raises(ValueError, match="1-D tap"):
+        ops.convolve2D_separable(np.zeros((8, 8), np.float32),
+                                 np.ones((5, 1), np.float32),
+                                 np.ones(3, np.float32))

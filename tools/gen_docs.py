@@ -33,6 +33,7 @@ MODULES = [
     "veles.simd_tpu.ops.spectral",
     "veles.simd_tpu.models.matched_filter",
     "veles.simd_tpu.models.denoiser",
+    "veles.simd_tpu.models.image",
     "veles.simd_tpu.models.pipeline",
     "veles.simd_tpu.models.spectral",
     "veles.simd_tpu.models.streaming",

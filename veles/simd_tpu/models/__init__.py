@@ -9,12 +9,16 @@ are first-class, jittable, batched, and mesh-shardable:
                          composition, tests/correlate.cc usage)
   WaveletDenoiser        SWT -> soft-threshold -> inverse SWT (built on
                          the beyond-parity reconstruction ops)
+  ImageWaveletDenoiser   2-D DWT pyramid -> shrink details -> inverse
+                         (the separable wavelet_apply2D family's
+                         standard use)
   SignalPipeline         normalize -> FIR -> SWT feature bands -> linear
                          head (the flagship __graft_entry__ workload)
 """
 
 from veles.simd_tpu.models.matched_filter import MatchedFilterDetector  # noqa: F401
 from veles.simd_tpu.models.denoiser import WaveletDenoiser  # noqa: F401
+from veles.simd_tpu.models.image import ImageWaveletDenoiser  # noqa: F401
 from veles.simd_tpu.models.pipeline import SignalPipeline  # noqa: F401
 from veles.simd_tpu.models.spectral import SpectralPeakAnalyzer  # noqa: F401
 from veles.simd_tpu.models.streaming import StreamingWaveletDenoiser  # noqa: F401

@@ -15,9 +15,10 @@ from veles.simd_tpu.ops.mathfun import cos_psv, exp_psv, log_psv, sin_psv  # noq
 from veles.simd_tpu.ops.matrix import (  # noqa: F401
     matrix_add, matrix_multiply, matrix_multiply_transposed, matrix_sub)
 from veles.simd_tpu.ops.convolve import (  # noqa: F401
-    ConvolutionHandle, causal_fir, convolve, convolve_fft,
-    convolve_finalize, convolve_initialize, convolve_overlap_save,
-    convolve_simd, select_algorithm)
+    ConvolutionHandle, causal_fir, convolve, convolve2D,
+    convolve2D_separable, convolve_fft, convolve_finalize,
+    convolve_initialize, convolve_overlap_save, convolve_simd,
+    select_algorithm)
 from veles.simd_tpu.ops.normalize import (  # noqa: F401
     minmax1D, minmax2D, normalize1D, normalize2D, normalize2D_minmax)
 from veles.simd_tpu.ops.detect_peaks import (  # noqa: F401

@@ -39,6 +39,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from veles.simd_tpu.config import resolve_impl
 from veles.simd_tpu.reference import convolve as _ref
@@ -349,6 +350,105 @@ def convolve(x, h, *, algorithm: Optional[str] = None, impl=None):
     handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm,
                                  impl=impl)
     return handle(x, h)
+
+
+# ---------------------------------------------------------------------------
+# 2-D convolution (beyond-parity: the reference is strictly 1-D; images
+# are the natural next surface, pairing with normalize2D/wavelet_apply2D)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _convolve2d_direct_xla(x, h):
+    """Small-kernel 2-D conv: kh*kw unit-stride shifted multiply-adds
+    over the padded plane — the 1-D shift-add schedule extended to two
+    axes (one fused VPU pass, no gather, no conv_general_dilated)."""
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    kh, kw = h.shape
+    oh, ow = x.shape[-2] + kh - 1, x.shape[-1] + kw - 1
+    pad = [(0, 0)] * (x.ndim - 2) + [(kh - 1, kh - 1), (kw - 1, kw - 1)]
+    xp = jnp.pad(x, pad)
+    acc = jnp.zeros(x.shape[:-2] + (oh, ow), jnp.float32)
+    for a in range(kh):  # static unroll; taps are runtime values
+        for b in range(kw):
+            acc = acc + (h[kh - 1 - a, kw - 1 - b]
+                         * xp[..., a:a + oh, b:b + ow])
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("fh", "fw"))
+def _convolve2d_fft_xla(x, h, fh, fw):
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    oh = x.shape[-2] + h.shape[-2] - 1
+    ow = x.shape[-1] + h.shape[-1] - 1
+    X = jnp.fft.rfft2(x, s=(fh, fw))
+    Hs = jnp.fft.rfft2(h, s=(fh, fw))
+    out = jnp.fft.irfft2(X * Hs, s=(fh, fw))
+    return out[..., :oh, :ow].astype(jnp.float32)
+
+
+#: per-tap unrolling makes direct's compile time linear in kh*kw; above
+#: this the batched 2-D FFT wins anyway (same shape of tradeoff as the
+#: 1-D _DIRECT_MAX_H, extended to the tap-count product)
+_DIRECT2D_MAX_TAPS = 192
+
+
+def convolve2D(x, h, *, algorithm: Optional[str] = None, impl=None):
+    """Full 2-D linear convolution -> (..., H+kh-1, W+kw-1).
+
+    ``algorithm``: "direct" (fused shift-add, small kernels) or "fft"
+    (batched rfft2); None picks by tap count (direct up to
+    _DIRECT2D_MAX_TAPS taps). Leading axes of ``x`` are batch. For
+    separable kernels prefer :func:`convolve2D_separable`
+    (O(kh+kw) per pixel).
+    """
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _ref.convolve2D(x, h)
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim < 2 or h.ndim != 2:
+        raise ValueError(
+            f"need x (..., H, W) and h (kh, kw); got {x.shape}, {h.shape}")
+    if algorithm is None:
+        algorithm = ("direct" if h.shape[-2] * h.shape[-1]
+                     <= _DIRECT2D_MAX_TAPS else "fft")
+    if algorithm == "direct":
+        if h.shape[-2] * h.shape[-1] > _DIRECT_UNROLL_MAX_H:
+            raise ValueError(
+                f"direct 2-D convolution caps at {_DIRECT_UNROLL_MAX_H} "
+                "taps (compile time is linear in the unroll); use "
+                "algorithm='fft'")
+        return _convolve2d_direct_xla(x, h)
+    if algorithm != "fft":
+        raise ValueError("algorithm must be 'direct', 'fft', or None")
+    fh = fft_convolution_length(x.shape[-2], h.shape[-2])
+    fw = fft_convolution_length(x.shape[-1], h.shape[-1])
+    return _convolve2d_fft_xla(x, h, fh, fw)
+
+
+def convolve2D_separable(x, h_row, h_col, *, impl=None):
+    """Full 2-D convolution with the rank-1 kernel
+    outer(h_col, h_row): the 1-D batch-aware direct conv along W, then
+    along H via a transpose — O(kh + kw) work per output pixel instead
+    of O(kh * kw)."""
+    if np.ndim(h_row) != 1 or np.ndim(h_col) != 1:
+        raise ValueError(
+            f"h_row and h_col must be 1-D tap vectors; got shapes "
+            f"{np.shape(h_row)}, {np.shape(h_col)}")
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        h2 = (np.asarray(h_col, np.float64)[:, None]
+              * np.asarray(h_row, np.float64)[None, :])
+        return _ref.convolve2D(x, h2)
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim < 2:
+        raise ValueError(f"need (..., H, W); got shape {x.shape}")
+    y = _convolve_direct_xla(x, jnp.asarray(h_row, jnp.float32))
+    yt = jnp.swapaxes(y, -1, -2)
+    z = _convolve_direct_xla(yt, jnp.asarray(h_col, jnp.float32))
+    return jnp.swapaxes(z, -1, -2)
 
 
 def convolve_simd(x, h, *, impl=None):

@@ -14,3 +14,16 @@ def convolve(x, h):
     x = np.asarray(x, dtype=np.float64)
     h = np.asarray(h, dtype=np.float64)
     return np.convolve(x, h, mode="full")
+
+
+def convolve2D(x, h):
+    """Full 2-D linear convolution oracle, (H+kh-1, W+kw-1), float64."""
+    from scipy.signal import convolve2d
+
+    x = np.asarray(x, dtype=np.float64)
+    h = np.asarray(h, dtype=np.float64)
+    if x.ndim == 2:
+        return convolve2d(x, h, mode="full")
+    flat = x.reshape((-1,) + x.shape[-2:])
+    out = np.stack([convolve2d(p, h, mode="full") for p in flat])
+    return out.reshape(x.shape[:-2] + out.shape[-2:])
