@@ -241,3 +241,50 @@ class TestStreamSharded:
                                       np.asarray(want_hi)[:, :x.shape[-1] - d])
         _, _, wcnt = ops.detect_peaks_fixed(y_all, capacity=x.shape[-1] - 2)
         assert int(np.sum(np.stack(peak_counts))) == int(np.sum(wcnt))
+
+
+class TestWaveletShardedBatched:
+    """dp x sp on one mesh: a batch of signals sharded (batch, seq),
+    every row matching the single-device op (the batch_axis extension;
+    normalize/peaks already had it, the wavelet family now too)."""
+
+    def test_dwt_dp_sp(self, rng):
+        mesh2 = parallel.make_mesh({"data": 2, "seq": 4})
+        x = rng.normal(size=(4, 256)).astype(np.float32)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        xs = jax.device_put(x, NamedSharding(mesh2, P("data", "seq")))
+        hi, lo = parallel.wavelet_apply_sharded(
+            xs, "daubechies", 8, "mirror", mesh=mesh2, axis="seq",
+            batch_axis="data")
+        want_hi, want_lo = ops.wavelet_apply(x, "daubechies", 8, "mirror",
+                                             impl="xla")
+        np.testing.assert_allclose(np.asarray(hi), np.asarray(want_hi),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(want_lo),
+                                   atol=1e-4)
+
+    def test_swt_replicated_batch(self, rng, mesh):
+        x = rng.normal(size=(3, 512)).astype(np.float32)
+        hi, lo = parallel.stationary_wavelet_apply_sharded(
+            x, "daubechies", 8, 2, "periodic", mesh=mesh, axis="seq",
+            batch_axis=True)
+        want_hi, want_lo = ops.stationary_wavelet_apply(
+            x, "daubechies", 8, 2, "periodic", impl="xla")
+        np.testing.assert_allclose(np.asarray(hi), np.asarray(want_hi),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(want_lo),
+                                   atol=1e-4)
+
+    def test_decompose_batched(self, rng, mesh):
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        details, approx = parallel.wavelet_decompose_sharded(
+            x, 2, "daubechies", 4, "periodic", mesh=mesh, axis="seq",
+            batch_axis=True)
+        want_d, want_a = ops.wavelet_decompose(x, 2, "daubechies", 4,
+                                               "periodic", impl="xla")
+        np.testing.assert_allclose(np.asarray(approx), np.asarray(want_a),
+                                   atol=1e-4)
+        for d, wd in zip(details, want_d):
+            np.testing.assert_allclose(np.asarray(d), np.asarray(wd),
+                                       atol=1e-4)

@@ -1,7 +1,7 @@
-"""2-D wavelet shrinkage image denoiser (DWT2 pyramid -> threshold ->
-inverse pyramid).
+"""2-D wavelet shrinkage image denoiser.
 
-The separable 2-D transform (ops.wavelet_apply2D family) put to its
+The pipeline is DWT2 pyramid -> threshold details -> inverse pyramid:
+the separable 2-D transform (ops.wavelet_apply2D family) put to its
 standard use: Donoho-Johnstone shrinkage on the detail bands of a
 multi-level image pyramid. Noise scale is estimated per image from the
 finest diagonal (hh) band via the median absolute deviation — the

@@ -61,7 +61,8 @@ def convolve_sharded(x, h, mesh, axis="seq", *, boundary="zero"):
 
 
 def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
-                          ext=EXTENSION_PERIODIC, *, mesh, axis="seq"):
+                          ext=EXTENSION_PERIODIC, *, mesh, axis="seq",
+                          batch_axis=None):
     """Sequence-parallel decimated DWT step -> (hi, lo), each length n/2
     sharded along ``axis``.
 
@@ -69,6 +70,10 @@ def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
     shard end, src/wavelet.c:247-268) becomes the halo from the next
     device; all four extension modes shard (mirror/constant tails are
     computed locally by the last shard — see halo_map's boundary policy).
+
+    ``batch_axis`` follows halo_map: ``None`` for 1-D signals, a mesh
+    axis name to shard a leading batch dim over it (dp x sp on one
+    mesh), or ``True`` for a replicated batch dim.
     """
     boundary = _shardable(ext)
     x = jnp.asarray(x, jnp.float32)
@@ -88,16 +93,17 @@ def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
         return jnp.concatenate([hi_b, lo_b], axis=-1)
 
     fn = halo_map(local, mesh, axis, right=order, boundary=boundary,
-                  n_broadcast_args=1)
+                  n_broadcast_args=1, batch_axis=batch_axis)
     both = fn(x, filters)  # per-shard [hi | lo] concatenated along the axis
     return _split_bands(both, mesh.shape[axis])
 
 
 def stationary_wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
                                      level=1, ext=EXTENSION_PERIODIC, *,
-                                     mesh, axis="seq"):
+                                     mesh, axis="seq", batch_axis=None):
     """Sequence-parallel stationary WT step -> full-length (hi, lo) pair
-    sharded along ``axis``. Halo = the dilated filter span."""
+    sharded along ``axis``. Halo = the dilated filter span.
+    ``batch_axis`` as in wavelet_apply_sharded."""
     boundary = _shardable(ext)
     if level < 1:
         raise ValueError("level must be >= 1")
@@ -113,7 +119,7 @@ def stationary_wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
         return jnp.concatenate([hi_b, lo_b], axis=-1)
 
     fn = halo_map(local, mesh, axis, right=span, boundary=boundary,
-                  n_broadcast_args=1)
+                  n_broadcast_args=1, batch_axis=batch_axis)
     both = fn(x, filters)
     return _split_bands(both, mesh.shape[axis])
 
@@ -129,13 +135,16 @@ def _shardable(ext):
 def _split_bands(both, n_shards):
     """Undo the per-shard [hi | lo] concatenation into two band arrays.
 
-    Each shard contributed [hi_k | lo_k]; globally the array interleaves
-    per-shard band pairs, so a reshape separates them without any
-    cross-device traffic at trace level (XLA sees a relayout).
+    Each shard contributed [hi_k | lo_k]; globally the last axis
+    interleaves per-shard band pairs, so a reshape separates them without
+    any cross-device traffic at trace level (XLA sees a relayout).
+    Leading axes (batch) pass through.
     """
+    lead = both.shape[:-1]
     n2 = both.shape[-1] // (2 * n_shards)
-    grouped = both.reshape(n_shards, 2, n2)
-    return grouped[:, 0, :].reshape(-1), grouped[:, 1, :].reshape(-1)
+    grouped = both.reshape(lead + (n_shards, 2, n2))
+    return (grouped[..., 0, :].reshape(lead + (-1,)),
+            grouped[..., 1, :].reshape(lead + (-1,)))
 
 
 def batch_map(fn, mesh, axis="data", *, n_broadcast_args=0):
@@ -154,7 +163,8 @@ def batch_map(fn, mesh, axis="data", *, n_broadcast_args=0):
 
 
 def wavelet_decompose_sharded(x, levels, wavelet_type="daubechies", order=8,
-                              ext=EXTENSION_PERIODIC, *, mesh, axis="seq"):
+                              ext=EXTENSION_PERIODIC, *, mesh, axis="seq",
+                              batch_axis=None):
     """Multi-level sequence-parallel DWT -> (details, approx).
 
     The sharded twin of ops.wavelet_decompose: each level's lowpass feeds
@@ -176,7 +186,8 @@ def wavelet_decompose_sharded(x, levels, wavelet_type="daubechies", order=8,
     lo = x
     for _ in range(levels):
         hi, lo = wavelet_apply_sharded(lo, wavelet_type, order, ext,
-                                       mesh=mesh, axis=axis)
+                                       mesh=mesh, axis=axis,
+                                       batch_axis=batch_axis)
         details.append(hi)
     return details, lo
 
@@ -184,7 +195,7 @@ def wavelet_decompose_sharded(x, levels, wavelet_type="daubechies", order=8,
 def stationary_wavelet_decompose_sharded(x, levels,
                                          wavelet_type="daubechies", order=8,
                                          ext=EXTENSION_PERIODIC, *, mesh,
-                                         axis="seq"):
+                                         axis="seq", batch_axis=None):
     """Multi-level sequence-parallel SWT -> (details, approx); level k
     exchanges an order * 2^(k-1) sample halo (the dilated filter span)."""
     if levels < 1:
@@ -193,7 +204,8 @@ def stationary_wavelet_decompose_sharded(x, levels,
     lo = jnp.asarray(x, jnp.float32)
     for level in range(1, levels + 1):
         hi, lo = stationary_wavelet_apply_sharded(
-            lo, wavelet_type, order, level, ext, mesh=mesh, axis=axis)
+            lo, wavelet_type, order, level, ext, mesh=mesh, axis=axis,
+            batch_axis=batch_axis)
         details.append(hi)
     return details, lo
 
