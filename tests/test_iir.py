@@ -1,0 +1,107 @@
+"""IIR (biquad cascade) suite: the associative-scan formulation vs the
+float64 scipy oracle, plus streaming exactness and design helpers."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.reference import iir as ref_iir
+
+
+def _sos(order=4, wn=0.2, btype="lowpass"):
+    return ops.butter_sos(order, wn, btype)
+
+
+class TestSosfilt:
+    @pytest.mark.parametrize("order,wn,btype", [(2, 0.1, "lowpass"),
+                                                (4, 0.25, "highpass"),
+                                                (6, 0.3, "lowpass"),
+                                                (5, 0.15, "lowpass")])
+    def test_differential(self, rng, order, wn, btype):
+        x = rng.normal(size=512).astype(np.float32)
+        sos = _sos(order, wn, btype)
+        want = ref_iir.sosfilt(x, sos)
+        got = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_bandpass(self, rng):
+        x = rng.normal(size=1024).astype(np.float32)
+        sos = ops.butter_sos(4, [0.2, 0.4], "bandpass")
+        want = ref_iir.sosfilt(x, sos)
+        got = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(3, 4, 300)).astype(np.float32)
+        sos = _sos()
+        got = np.asarray(ops.sosfilt(x, sos))
+        want = ref_iir.sosfilt(x, sos)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_lowpass_attenuates_high_tone(self):
+        n = 2048
+        t = np.arange(n, dtype=np.float64)
+        lo_tone = np.sin(2 * np.pi * 0.02 * t).astype(np.float32)
+        hi_tone = np.sin(2 * np.pi * 0.45 * t).astype(np.float32)
+        sos = _sos(6, 0.2)
+        y_lo = np.asarray(ops.sosfilt(lo_tone, sos))
+        y_hi = np.asarray(ops.sosfilt(hi_tone, sos))
+        # steady-state amplitudes: passband ~unity, stopband crushed
+        assert np.std(y_lo[500:]) > 0.6
+        assert np.std(y_hi[500:]) < 0.01
+
+    def test_sos_contracts(self):
+        with pytest.raises(ValueError):
+            ops.sosfilt(np.zeros(8, np.float32),
+                        np.zeros((2, 5), np.float32))
+        bad = np.zeros((1, 6), np.float32)
+        bad[0, 3] = 2.0  # a0 != 1
+        with pytest.raises(ValueError, match="normalized"):
+            ops.sosfilt(np.zeros(8, np.float32), bad)
+
+
+class TestIirStream:
+    @pytest.mark.parametrize("chunk", [64, 100, 256])
+    def test_concat_matches_whole(self, rng, chunk):
+        n = chunk * 5
+        x = rng.normal(size=n).astype(np.float32)
+        sos = _sos(4, 0.2)
+        st = ops.iir_stream_init(sos)
+        outs = []
+        for i in range(0, n, chunk):
+            st, y = ops.iir_stream_step(st, x[i:i + chunk], sos)
+            outs.append(np.asarray(y))
+        got = np.concatenate(outs)
+        want = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_state_matches_scipy_zi(self, rng):
+        """The carried state IS scipy's zi: filtering a chunk with our
+        final state as scipy's initial state continues the stream."""
+        x = rng.normal(size=256).astype(np.float32)
+        sos = _sos(4, 0.3)
+        st = ops.iir_stream_init(sos)
+        st, y1 = ops.iir_stream_step(st, x[:128], sos)
+        want2, _ = ref_iir.sosfilt(x[128:], sos,
+                                   zi=np.asarray(st.state))
+        _, got2 = ops.iir_stream_step(st, x[128:], sos)
+        np.testing.assert_allclose(np.asarray(got2), np.ravel(want2),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batched_stream(self, rng):
+        x = rng.normal(size=(3, 200)).astype(np.float32)
+        sos = _sos(3, 0.25)
+        st = ops.iir_stream_init(sos, batch_shape=(3,))
+        st, y1 = ops.iir_stream_step(st, x[:, :100], sos)
+        st, y2 = ops.iir_stream_step(st, x[:, 100:], sos)
+        got = np.concatenate([np.asarray(y1), np.asarray(y2)], axis=-1)
+        want = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_state_shape_contract(self):
+        sos = _sos(4, 0.2)
+        st = ops.iir_stream_init(sos)
+        other = _sos(2, 0.2)
+        with pytest.raises(ValueError, match="sections"):
+            ops.iir_stream_step(st, np.zeros(16, np.float32), other)

@@ -11,6 +11,7 @@ from veles.simd_tpu.reference import arithmetic  # noqa: F401
 from veles.simd_tpu.reference import convolve  # noqa: F401
 from veles.simd_tpu.reference import correlate  # noqa: F401
 from veles.simd_tpu.reference import detect_peaks  # noqa: F401
+from veles.simd_tpu.reference import iir  # noqa: F401
 from veles.simd_tpu.reference import mathfun  # noqa: F401
 from veles.simd_tpu.reference import matrix  # noqa: F401
 from veles.simd_tpu.reference import normalize  # noqa: F401

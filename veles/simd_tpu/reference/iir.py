@@ -1,0 +1,38 @@
+"""Oracle for IIR filtering (biquad cascades, scipy sos convention).
+
+float64 scipy.signal.sosfilt is the definition; the TPU implementation
+(ops/iir.py) must match it to float32 tolerance for stable filters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _check_sos(sos):
+    sos = np.asarray(sos, dtype=np.float64)
+    if sos.ndim != 2 or sos.shape[-1] != 6:
+        raise ValueError(f"sos must be (n_sections, 6); got {sos.shape}")
+    if not np.allclose(sos[:, 3], 1.0):
+        raise ValueError("sos rows must be normalized (a0 == 1)")
+    return sos
+
+
+def sosfilt(x, sos, zi=None):
+    from scipy.signal import sosfilt as _sosfilt
+
+    sos = _check_sos(sos)
+    x = np.asarray(x, dtype=np.float64)
+    flat = x.reshape(-1, x.shape[-1])
+    if zi is None:
+        out = np.stack([_sosfilt(sos, r) for r in flat])
+        return out.reshape(x.shape)
+    zi = np.asarray(zi, dtype=np.float64).reshape(-1, sos.shape[0], 2)
+    outs, zfs = [], []
+    for r, z in zip(flat, zi):
+        y, zf = _sosfilt(sos, r, zi=z)
+        outs.append(y)
+        zfs.append(zf)
+    out = np.stack(outs).reshape(x.shape)
+    zf = np.stack(zfs).reshape(x.shape[:-1] + (sos.shape[0], 2))
+    return out, zf
