@@ -105,3 +105,30 @@ class TestIirStream:
         other = _sos(2, 0.2)
         with pytest.raises(ValueError, match="sections"):
             ops.iir_stream_step(st, np.zeros(16, np.float32), other)
+
+
+class TestSosfiltfilt:
+    def test_zero_phase_tone(self):
+        # a passband tone comes back with no phase shift (the forward
+        # pass alone delays it)
+        n = 4096
+        t = np.arange(n, dtype=np.float64)
+        x = np.sin(2 * np.pi * 0.02 * t).astype(np.float32)
+        sos = ops.butter_sos(4, 0.2)
+        y = np.asarray(ops.sosfiltfilt(x, sos))
+        fwd = np.asarray(ops.sosfilt(x, sos))
+        mid = slice(1000, 3000)
+        # zero-phase: correlates best at lag 0; forward-only does not
+        def best_lag(sig):
+            lags = range(-40, 41)
+            return max(lags, key=lambda L: float(
+                np.dot(sig[mid], np.roll(x, L)[mid])))
+        assert best_lag(y) == 0
+        assert best_lag(fwd) != 0
+
+    def test_matches_reference(self, rng):
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        sos = ops.butter_sos(4, 0.3)
+        want = ops.sosfiltfilt(x, sos, impl="reference")
+        got = np.asarray(ops.sosfiltfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

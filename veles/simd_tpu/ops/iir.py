@@ -109,6 +109,25 @@ def sosfilt(x, sos, *, impl=None):
     return y
 
 
+def sosfiltfilt(x, sos, *, impl=None):
+    """Zero-phase filtering: forward pass, reverse, forward pass,
+    reverse — squares the magnitude response and cancels the phase.
+
+    Simpler contract than scipy.signal.sosfiltfilt: no edge padding or
+    initial-condition matching, so the two agree away from the ends but
+    differ in the first/last transient spans (document-by-construction;
+    pad the signal if edges matter). Leading axes are batch.
+    """
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        fwd = _ref.sosfilt(x, sos)
+        return _ref.sosfilt(fwd[..., ::-1], sos)[..., ::-1]
+    # pin the inner calls: re-resolving the ambient impl here would
+    # override an explicit impl= (the jitted-caller pinning convention)
+    fwd = sosfilt(x, sos, impl="xla")
+    return sosfilt(fwd[..., ::-1], sos, impl="xla")[..., ::-1]
+
+
 def butter_sos(order, wn, btype="lowpass"):
     """Butterworth design (host-side, float64 scipy): normalized cutoff
     ``wn`` in (0, 1) as a fraction of Nyquist; returns (n_sections, 6)."""

@@ -357,9 +357,32 @@ def bench_spectral(scale=1):
             **_msps(st, batch * n)}
 
 
+def bench_iir(scale=1):
+    """Batched IIR (butterworth-6 cascade) via the associative-scan
+    formulation: 256 signals x 4096 samples — the op family a
+    sample-serial loop cannot express on TPU at all (ops/iir.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu import ops
+
+    batch, n = 256, max(int(4096 * scale), 256)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+    sos = jnp.asarray(ops.butter_sos(6, 0.2), jnp.float32)
+
+    def step(c):
+        return ops.sosfilt(c, sos) * jnp.float32(0.999)
+
+    st = chain_stat(step, x, iters=1024, on_floor="nan",
+                    null_carry=x[:1, :8])
+    return {"metric": f"sosfilt_butter6_b{batch}_n{n}",
+            **_msps(st, batch * n)}
+
+
 CONFIGS = (bench_elementwise, bench_convolve, bench_convolve_batched,
            bench_dwt, bench_batched_pipeline, bench_flagship, bench_stream,
-           bench_spectral, bench_feed_io)
+           bench_spectral, bench_iir, bench_feed_io)
 
 
 def collect_secondary(scale=None, progress=None) -> dict:

@@ -132,6 +132,30 @@ def default_configs():
         lambda c: ops.sin_psv(c, impl="xla") * jnp.float32(0.99),
         xmj, 8192))
 
+    # sosfilt: butterworth-6 over 256x4096 batch (the associative-scan
+    # IIR vs scipy's sample-serial C loop — host runs 8 rows)
+    sos = ops.butter_sos(6, 0.2)
+    xi = rng.normal(size=(256, 4096)).astype(np.float32)
+    xij = jnp.asarray(xi)
+    cfgs.append((
+        "sosfilt butter6 256x4096 (host: 8 rows)",
+        lambda xi=xi, sos=sos: reference.iir.sosfilt(xi[:8], sos),
+        lambda c, sos=jnp.asarray(sos, jnp.float32):
+            ops.sosfilt(c, sos) * jnp.float32(0.999),
+        xij, 512, 32.0))
+
+    # upfirdn 3/2 over 64x16384 (polyphase resample)
+    hr = np.asarray(ops.resample_filter(3, 2, taps_per_phase=8),
+                    np.float32)
+    xr = rng.normal(size=(64, 16384)).astype(np.float32)
+    xrj = jnp.asarray(xr)
+    cfgs.append((
+        "upfirdn 3/2 64x16384",
+        lambda xr=xr, hr=hr: reference.resample.upfirdn(xr, hr, 3, 2),
+        lambda c, hrj=jnp.asarray(hr):
+            ops.upfirdn(c, hrj, 3, 2)[..., :16384],
+        xrj, 512))
+
     return cfgs
 
 
