@@ -118,14 +118,12 @@ def sosfiltfilt(x, sos, *, impl=None):
     differ in the first/last transient spans (document-by-construction;
     pad the signal if edges matter). Leading axes are batch.
     """
+    # pass the RESOLVED impl through: the inner calls must never
+    # re-resolve the ambient setting over an explicit impl= (the
+    # jitted-caller pinning convention)
     impl = resolve_impl(impl)
-    if impl == "reference":
-        fwd = _ref.sosfilt(x, sos)
-        return _ref.sosfilt(fwd[..., ::-1], sos)[..., ::-1]
-    # pin the inner calls: re-resolving the ambient impl here would
-    # override an explicit impl= (the jitted-caller pinning convention)
-    fwd = sosfilt(x, sos, impl="xla")
-    return sosfilt(fwd[..., ::-1], sos, impl="xla")[..., ::-1]
+    fwd = sosfilt(x, sos, impl=impl)
+    return sosfilt(fwd[..., ::-1], sos, impl=impl)[..., ::-1]
 
 
 def butter_sos(order, wn, btype="lowpass"):

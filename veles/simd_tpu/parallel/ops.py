@@ -255,6 +255,23 @@ def normalize1D_sharded(x, *, mesh, axis="seq", batch_axis=None):
         jnp.asarray(x, jnp.float32))
 
 
+def sosfilt_sharded(x, sos, *, mesh, axis="seq", batch_axis=None):
+    """IIR filtering of a sequence-sharded (batch, n) block.
+
+    An IIR recurrence has unbounded memory, so the halo pattern cannot
+    shard it (no finite boundary exchange reproduces the state); the
+    all-to-all layout swap can: each device receives complete signals
+    for a slice of the batch, runs the associative-scan sosfilt
+    (ops/iir.py) unrestricted, and swaps back. Output layout matches the
+    input.
+    """
+    from veles.simd_tpu.ops.iir import sosfilt
+
+    fn = alltoall_map(lambda sig: sosfilt(sig, sos, impl="xla"),
+                      mesh, axis, batch_axis=batch_axis)
+    return fn(jnp.asarray(x, jnp.float32))
+
+
 def detect_peaks_fixed_sharded(data, extremum_type=None, *, capacity, mesh,
                                axis="seq", batch_axis=None):
     """Fixed-capacity peak detection over a sequence-sharded (batch, n)

@@ -372,7 +372,7 @@ def bench_iir(scale=1):
     sos = jnp.asarray(ops.butter_sos(6, 0.2), jnp.float32)
 
     def step(c):
-        return ops.sosfilt(c, sos) * jnp.float32(0.999)
+        return ops.sosfilt(c, sos, impl="xla") * jnp.float32(0.999)
 
     st = chain_stat(step, x, iters=1024, on_floor="nan",
                     null_carry=x[:1, :8])

@@ -141,7 +141,7 @@ def default_configs():
         "sosfilt butter6 256x4096 (host: 8 rows)",
         lambda xi=xi, sos=sos: reference.iir.sosfilt(xi[:8], sos),
         lambda c, sos=jnp.asarray(sos, jnp.float32):
-            ops.sosfilt(c, sos) * jnp.float32(0.999),
+            ops.sosfilt(c, sos, impl="xla") * jnp.float32(0.999),
         xij, 512, 32.0))
 
     # upfirdn 3/2 over 64x16384 (polyphase resample)
@@ -153,7 +153,7 @@ def default_configs():
         "upfirdn 3/2 64x16384",
         lambda xr=xr, hr=hr: reference.resample.upfirdn(xr, hr, 3, 2),
         lambda c, hrj=jnp.asarray(hr):
-            ops.upfirdn(c, hrj, 3, 2)[..., :16384],
+            ops.upfirdn(c, hrj, 3, 2, impl="xla")[..., :16384],
         xrj, 512))
 
     return cfgs
