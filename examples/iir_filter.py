@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""IIR filtering demo: butterworth biquad cascades on TPU via parallel
+associative scan, whole-signal and streaming.
+
+    python examples/iir_filter.py
+
+An IIR recurrence is "inherently sequential" — except it isn't: as an
+affine state recurrence it solves in O(log n) depth on the VPU
+(ops/iir.py). The demo separates a two-tone signal with a lowpass /
+highpass pair, then runs the same filter chunk-by-chunk with carried
+state (interchangeable with scipy's zi).
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from veles.simd_tpu import ops  # noqa: E402
+
+
+def main():
+    n = 8192
+    t = np.arange(n)
+    lo = np.sin(2 * np.pi * 0.01 * t)
+    hi = 0.5 * np.sin(2 * np.pi * 0.35 * t)
+    x = (lo + hi).astype(np.float32)
+
+    sos_lp = ops.butter_sos(6, 0.1)
+    sos_hp = ops.butter_sos(6, 0.3, "highpass")
+    y_lo = np.asarray(ops.sosfiltfilt(x, sos_lp))  # zero-phase
+    y_hi = np.asarray(ops.sosfilt(x, sos_hp))
+    mid = slice(1000, 7000)
+    print(f"two-tone split: lowpass residual vs slow tone "
+          f"{np.std(y_lo[mid] - lo[mid]):.4f}; "
+          f"highpass keeps fast tone to "
+          f"{np.std(y_hi[mid]) / np.std(hi[mid]):.3f}x amplitude")
+
+    # streaming: 512-sample chunks, state carried
+    st = ops.iir_stream_init(sos_lp)
+    outs = []
+    for i in range(0, n, 512):
+        st, y = ops.iir_stream_step(st, x[i:i + 512], sos_lp)
+        outs.append(np.asarray(y))
+    stream = np.concatenate(outs)
+    whole = np.asarray(ops.sosfilt(x, sos_lp))
+    print("streaming == whole-signal (1e-5):",
+          np.allclose(stream, whole, atol=1e-5))
+
+
+if __name__ == "__main__":
+    main()
