@@ -292,3 +292,20 @@ def test_separable_rejects_2d_taps():
         ops.convolve2D_separable(np.zeros((8, 8), np.float32),
                                  np.ones((5, 1), np.float32),
                                  np.ones(3, np.float32))
+
+
+class TestConvolve2DFuzz:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_shapes_agree(self, seed):
+        g = np.random.default_rng(6000 + seed)
+        hh, ww = int(g.integers(4, 80)), int(g.integers(4, 80))
+        kh, kw = int(g.integers(1, 12)), int(g.integers(1, 12))
+        x = g.normal(size=(hh, ww)).astype(np.float32)
+        h = (g.normal(size=(kh, kw)) / (kh * kw)).astype(np.float32)
+        want = ops.convolve2D(x, h, impl="reference")
+        scale = np.abs(want).max() + 1.0
+        for alg in ("direct", "fft"):
+            got = np.asarray(ops.convolve2D(x, h, algorithm=alg))
+            np.testing.assert_allclose(
+                got / scale, want / scale, atol=5e-5,
+                err_msg=f"seed={seed} x=({hh},{ww}) h=({kh},{kw}) {alg}")

@@ -132,3 +132,45 @@ class TestSosfiltfilt:
         want = ops.sosfiltfilt(x, sos, impl="reference")
         got = np.asarray(ops.sosfiltfilt(x, sos))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestIirFuzz:
+    """Random filter designs x random shapes vs the float64 oracle —
+    the adversarial-shape differential pattern (test_convolve.py's
+    TestAlgorithmEquivalenceFuzz applied to the IIR family)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_designs_agree(self, seed):
+        g = np.random.default_rng(3000 + seed)
+        order = int(g.integers(1, 9))
+        wn = float(g.uniform(0.05, 0.45))
+        btype = ("lowpass", "highpass")[int(g.integers(0, 2))]
+        n = int(g.integers(16, 3000))
+        x = g.normal(size=n).astype(np.float32)
+        sos = ops.butter_sos(order, wn, btype)
+        want = ref_iir.sosfilt(x, sos)
+        got = np.asarray(ops.sosfilt(x, sos))
+        scale = np.abs(want).max() + 1.0
+        np.testing.assert_allclose(
+            got / scale, want / scale, atol=5e-5,
+            err_msg=f"seed={seed} order={order} wn={wn:.3f} "
+                    f"{btype} n={n}")
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_chunking_agrees(self, seed):
+        g = np.random.default_rng(4000 + seed)
+        n = 1024
+        x = g.normal(size=n).astype(np.float32)
+        sos = ops.butter_sos(int(g.integers(2, 7)),
+                             float(g.uniform(0.1, 0.4)))
+        cuts = np.sort(g.choice(np.arange(1, n),
+                                size=int(g.integers(2, 6)),
+                                replace=False))
+        st = ops.iir_stream_init(sos)
+        outs = []
+        for seg in np.split(x, cuts):
+            st, y = ops.iir_stream_step(st, seg, sos)
+            outs.append(np.asarray(y))
+        got = np.concatenate(outs)
+        want = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

@@ -129,3 +129,24 @@ class TestResampleStream:
         with pytest.raises(ValueError, match="divisible"):
             ops.resample_stream_step(st, np.zeros(64, np.float32), h,
                                      up=2, down=3)
+
+
+class TestResampleFuzz:
+    """Random (up, down, n, m) vs the float64 oracle."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_factors_agree(self, seed):
+        g = np.random.default_rng(5000 + seed)
+        up = int(g.integers(1, 9))
+        down = int(g.integers(1, 9))
+        n = int(g.integers(8, 1500))
+        m = int(g.integers(1, 80))
+        x = g.normal(size=n).astype(np.float32)
+        h = (g.normal(size=m) / max(m, 1)).astype(np.float32)
+        want = ref_resample.upfirdn(x, h, up, down)
+        got = np.asarray(ops.upfirdn(x, h, up, down))
+        assert got.shape == want.shape, (up, down, n, m)
+        scale = np.abs(want).max() + 1.0
+        np.testing.assert_allclose(
+            got / scale, want / scale, atol=5e-5,
+            err_msg=f"seed={seed} up={up} down={down} n={n} m={m}")
