@@ -23,7 +23,8 @@ from veles.simd_tpu.ops.normalize import (  # noqa: F401
     minmax1D, minmax2D, normalize1D, normalize2D, normalize2D_minmax)
 from veles.simd_tpu.ops.detect_peaks import (  # noqa: F401
     EXTREMUM_TYPE_BOTH, EXTREMUM_TYPE_MAXIMUM, EXTREMUM_TYPE_MINIMUM,
-    detect_peaks, detect_peaks_fixed, detect_peaks_topk)
+    detect_peaks, detect_peaks2D_fixed, detect_peaks_fixed,
+    detect_peaks_topk)
 from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     EXTENSION_CONSTANT, EXTENSION_MIRROR, EXTENSION_PERIODIC, EXTENSION_TYPES,
     EXTENSION_ZERO, stationary_wavelet_apply, stationary_wavelet_decompose,

@@ -30,3 +30,26 @@ def detect_peaks(data, extremum_type=EXTREMUM_TYPE_BOTH):
         sel |= strict & (d1 < 0)
     positions = np.nonzero(sel)[0] + 1
     return positions.astype(np.int32), data[positions]
+
+
+def detect_peaks2D(img, extremum_type=EXTREMUM_TYPE_BOTH):
+    """2-D oracle: strict local extrema over the 8-neighborhood of every
+    interior pixel -> (rows, cols, values), float64, row-major order."""
+    img = np.asarray(img, dtype=np.float64)
+    if img.ndim != 2:
+        raise ValueError(f"need (H, W); got shape {img.shape}")
+    c = img[1:-1, 1:-1]
+    shifts = [img[1 + di:img.shape[0] - 1 + di,
+                  1 + dj:img.shape[1] - 1 + dj]
+              for di in (-1, 0, 1) for dj in (-1, 0, 1)
+              if (di, dj) != (0, 0)]
+    is_max = np.logical_and.reduce([c > s for s in shifts])
+    is_min = np.logical_and.reduce([c < s for s in shifts])
+    sel = np.zeros_like(is_max)
+    if extremum_type & EXTREMUM_TYPE_MAXIMUM:
+        sel |= is_max
+    if extremum_type & EXTREMUM_TYPE_MINIMUM:
+        sel |= is_min
+    rows, cols = np.nonzero(sel)
+    return (rows.astype(np.int32) + 1, cols.astype(np.int32) + 1,
+            img[rows + 1, cols + 1])
