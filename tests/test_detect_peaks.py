@@ -229,14 +229,33 @@ class TestDetectPeaks2D:
 
     def test_large_flat_index_space_takes_sort_path(self, monkeypatch):
         """Flat 2-D indices near/past 2^24 must not ride the float32
-        one-hot iota (odd indices would round to even); pin the guard by
-        shrinking it and checking coordinates stay exact."""
+        one-hot iota (odd indices would round to even): shrink the guard
+        and assert the ROUTING — the one-hot branch must not trace at
+        all (a unique shape defeats the jit cache)."""
         import importlib
         # the re-exported detect_peaks FUNCTION shadows the submodule
         dp = importlib.import_module("veles.simd_tpu.ops.detect_peaks")
         monkeypatch.setattr(dp, "_ONEHOT_COMPACT_MAX_M", 64)
-        img = np.zeros((40, 40), np.float32)
-        img[37, 38] = 1.0  # late flat index, would stress the iota path
+
+        def boom(*a, **k):
+            raise AssertionError("one-hot path taken past the m guard")
+
+        monkeypatch.setattr(dp, "_compact_onehot", boom)
+        img = np.zeros((41, 39), np.float32)  # unique shape: fresh trace
+        img[37, 36] = 1.0
         rows, cols, vals, count = dp.detect_peaks2D_fixed(img, capacity=4)
         assert int(count) == 1
-        assert (int(rows[0]), int(cols[0])) == (37, 38)
+        assert (int(rows[0]), int(cols[0])) == (37, 36)
+
+    def test_nonfinite_pixel_does_not_poison_values(self):
+        """A NaN pixel elsewhere must not leak into other peaks' values
+        through the one-hot dot (0 * nan = nan); the reference backend
+        is the contract."""
+        img = np.zeros((10, 10), np.float32)
+        img[2, 3] = 5.0
+        img[7, 7] = np.nan
+        rows, cols, vals, count = D.detect_peaks2D_fixed(img, capacity=4)
+        k = int(count)
+        got = {(int(r), int(c)): float(v)
+               for r, c, v in zip(rows[:k], cols[:k], vals[:k])}
+        assert got[(2, 3)] == 5.0  # not NaN

@@ -117,9 +117,18 @@ def test_standard_bundle(tmp_path, rng):
     path = vexport.standard_bundle(tmp_path / "dist", length=1024,
                                    batch=4, n=64)
     loaded = vexport.load_bundle(path)
-    assert len(loaded) == 10
+    assert len(loaded) == 12
 
     x = rng.standard_normal(1024, dtype=np.float32)
+    # round-2 families round-trip too
+    got_rs = np.asarray(loaded["resample_3_2"](x))
+    want_rs = np.asarray(ops.resample_poly(x, 3, 2))
+    np.testing.assert_allclose(got_rs, want_rs, atol=1e-5)
+    xb = rng.standard_normal((4, 1024), dtype=np.float32)
+    sos = ops.butter_sos(6, 0.2)
+    got_sf = np.asarray(loaded["sosfilt_butter6"](xb))
+    want_sf = np.asarray(ops.sosfilt(xb, sos))
+    np.testing.assert_allclose(got_sf, want_sf, atol=1e-5)
     hi, lo = ops.wavelet_apply(x, "daubechies", 8)
     got_hi, got_lo = loaded["wavelet_apply_db8"](x)
     np.testing.assert_allclose(np.asarray(got_hi), np.asarray(hi), atol=1e-5)

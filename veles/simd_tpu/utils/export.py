@@ -31,6 +31,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import export as _jexport
 
 _MANIFEST = "manifest.json"
@@ -157,6 +158,9 @@ def standard_bundle(path, *, length=4096, batch=128, n=1024,
     a = jax.ShapeDtypeStruct
 
     h_len = 127
+    # deployment artifacts ship the designed filter baked as a constant,
+    # like the reference ships its coefficient tables
+    sos = np.asarray(O.butter_sos(6, 0.2), np.float32)
     bundle = {
         "matrix_multiply": (
             O.matrix_multiply, (a((n, n), f32), a((n, n), f32))),
@@ -179,5 +183,12 @@ def standard_bundle(path, *, length=4096, batch=128, n=1024,
         "cos_psv": (O.cos_psv, (a((length,), f32),)),
         "log_psv": (O.log_psv, (a((length,), f32),)),
         "exp_psv": (O.exp_psv, (a((length,), f32),)),
+        # round-2 families: rational resampling and the IIR cascade
+        "resample_3_2": (
+            lambda x: O.resample_poly(x, 3, 2),
+            (a((length,), f32),)),
+        "sosfilt_butter6": (
+            lambda x: O.sosfilt(x, sos),
+            (a((batch, length), f32),)),
     }
     return save_bundle(path, bundle, platforms=platforms)
