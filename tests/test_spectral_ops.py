@@ -218,3 +218,34 @@ def test_istft_impl_reference_differential(rng):
     # oracle also honors the zero-pad length contract
     w = ops.istft(spec, nfft=128, hop=32, length=1200, impl="reference")
     assert w.shape == (1200,) and np.all(w[1100:] == 0)
+
+
+class TestHilbert:
+    """Analytic signal / envelope vs scipy oracle."""
+
+    @pytest.mark.parametrize("n", [64, 129, 1024])
+    def test_matches_scipy(self, rng, n):
+        from veles.simd_tpu.reference import spectral as refs
+        x = rng.normal(size=n).astype(np.float32)
+        want = refs.hilbert(x)
+        got = np.asarray(ops.hilbert(x))
+        np.testing.assert_allclose(got.real, want.real, atol=1e-4)
+        np.testing.assert_allclose(got.imag, want.imag, atol=1e-4)
+
+    def test_envelope_of_am_tone(self):
+        # AM demodulation: envelope of (1 + 0.5 cos(wm t)) cos(wc t)
+        n = 4096
+        t = np.arange(n)
+        mod = 1.0 + 0.5 * np.cos(2 * np.pi * 0.002 * t)
+        x = (mod * np.cos(2 * np.pi * 0.2 * t)).astype(np.float32)
+        env = np.asarray(ops.envelope(x))
+        mid = slice(200, n - 200)
+        np.testing.assert_allclose(env[mid], mod[mid], atol=0.02)
+
+    def test_batched(self, rng):
+        from veles.simd_tpu.reference import spectral as refs
+        x = rng.normal(size=(3, 256)).astype(np.float32)
+        got = np.asarray(ops.envelope(x))
+        want = refs.envelope(x)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-4)

@@ -47,7 +47,8 @@ from veles.simd_tpu.ops.iir import (  # noqa: F401
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
-    frame, hann_window, istft, overlap_add, spectrogram, stft, welch)
+    envelope, frame, hann_window, hilbert, istft, overlap_add,
+    spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,

@@ -175,3 +175,38 @@ def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
     p = spectrogram(x, nfft=nfft, hop=hop, window=w, impl="xla")
     return (jnp.mean(p, axis=-2) /
             (jnp.sum(w * w) * nfft)).astype(jnp.float32)
+
+
+@jax.jit
+def _hilbert_xla(x):
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    spec = jnp.fft.fft(x, axis=-1)
+    # analytic-signal weights: DC and (for even n) Nyquist stay, positive
+    # frequencies double, negative zero (scipy.signal.hilbert's h)
+    h = np.zeros(n)
+    if n % 2 == 0:
+        h[0] = h[n // 2] = 1.0
+        h[1:n // 2] = 2.0
+    else:
+        h[0] = 1.0
+        h[1:(n + 1) // 2] = 2.0
+    return jnp.fft.ifft(spec * jnp.asarray(h), axis=-1)
+
+
+def hilbert(x, *, impl=None):
+    """Analytic signal via the frequency-domain construction -> complex
+    (..., n); the imaginary part is the Hilbert transform of ``x``.
+    Leading axes are batch. Oracle: scipy.signal.hilbert.
+    """
+    if resolve_impl(impl) == "reference":
+        return _ref.hilbert(x)
+    return _hilbert_xla(x)
+
+
+def envelope(x, *, impl=None):
+    """Instantaneous amplitude |analytic(x)| — AM demodulation / energy
+    tracking (the classic matched-filter postprocessing companion)."""
+    if resolve_impl(impl) == "reference":
+        return _ref.envelope(x)
+    return jnp.abs(_hilbert_xla(x)).astype(jnp.float32)

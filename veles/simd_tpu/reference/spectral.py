@@ -86,3 +86,15 @@ def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
     w = _window(nfft, window)
     p = spectrogram(x, nfft=nfft, hop=hop, window=w)
     return p.mean(axis=-2) / (np.sum(w * w) * nfft)
+
+
+def hilbert(x):
+    """Analytic signal oracle (scipy.signal.hilbert, float64 -> complex)."""
+    from scipy.signal import hilbert as _hilbert
+
+    return _hilbert(np.asarray(x, dtype=np.float64), axis=-1)
+
+
+def envelope(x):
+    """Instantaneous amplitude |analytic(x)|."""
+    return np.abs(hilbert(x))
