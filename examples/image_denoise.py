@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""2-D wavelet image denoising demo.
+
+    python examples/image_denoise.py
+
+Builds a synthetic image (overlapping Gaussian blobs on gradients), adds
+noise, denoises with multi-level 2-D wavelet shrinkage
+(models.ImageWaveletDenoiser), and reports the PSNR gain; then locates
+the blob centers on the cleaned image with 2-D peak detection.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from veles.simd_tpu import ops
+    from veles.simd_tpu.models import ImageWaveletDenoiser
+
+    h = w = 128
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    clean = np.zeros((h, w), np.float32)
+    centers = [(32, 32), (32, 96), (96, 64)]
+    for cy, cx in centers:
+        clean += 3.0 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 60.0)
+    rng = np.random.default_rng(0)
+    noisy = clean + 0.35 * rng.normal(size=(h, w)).astype(np.float32)
+
+    den = ImageWaveletDenoiser("daubechies", 8, levels=3)
+    out = np.asarray(den(noisy))
+
+    def psnr(a):
+        mse = np.mean((a - clean) ** 2)
+        return 10 * np.log10(clean.max() ** 2 / mse)
+
+    print(f"PSNR: noisy {psnr(noisy):.1f} dB -> denoised {psnr(out):.1f} dB")
+
+    # capacity truncation is row-major (first peaks win), so ranking by
+    # value needs full capacity first, then a top-k over the values
+    rows, cols, vals, count = ops.detect_peaks2D_fixed(
+        out, ops.EXTREMUM_TYPE_MAXIMUM)
+    k = int(count)
+    top = sorted(zip(np.asarray(vals)[:k], np.asarray(rows)[:k],
+                     np.asarray(cols)[:k]), reverse=True)[:3]
+    found = sorted((int(r), int(c)) for _, r, c in top)
+    print("blob centers found:", found, "(planted:", sorted(centers), ")")
+    ok = all(min(abs(r - cy) + abs(c - cx)
+                 for cy, cx in centers) <= 3 for r, c in found)
+    print("all within 3 px:", ok)
+
+
+if __name__ == "__main__":
+    main()
