@@ -31,7 +31,10 @@ def main():
     from veles.simd_tpu.host.ring import RingBuffer
     from veles.simd_tpu.models import StreamingWaveletDenoiser
 
-    fs, n, chunk = 16000.0, 65536, 2048
+    # demo scale (a backend probe here would initialize the TPU tunnel
+    # just to pick a size — not worth a hang when the tunnel is down);
+    # raise n freely on a TPU host
+    fs, n, chunk = 16000.0, 16384, 2048
     t = np.arange(n) / fs
     rng = np.random.default_rng(7)
     clean = np.sin(2 * np.pi * 220.0 * t).astype(np.float32)

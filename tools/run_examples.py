@@ -1,0 +1,57 @@
+"""Run every example end-to-end (CPU-forced) and report pass/fail.
+
+Examples are living documentation; this keeps them from rotting as the
+API moves. Not part of the default pytest run (examples compile real
+pipelines — minutes of CPU); invoke directly or from CI at release
+points:
+
+    python tools/run_examples.py [name ...]
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+_RUNNER = (
+    "import jax; jax.config.update('jax_platforms','cpu'); "
+    "import runpy, sys; runpy.run_path(sys.argv[1], run_name='__main__')"
+)
+
+
+def main():
+    names = sys.argv[1:]
+    files = sorted(f for f in os.listdir(EXAMPLES_DIR)
+                   if f.endswith(".py"))
+    if names:
+        files = [f for f in files if f[:-3] in names or f in names]
+    failures = []
+    for f in files:
+        path = os.path.join(EXAMPLES_DIR, f)
+        t0 = time.perf_counter()
+        # both the env var AND the config update: the axon plugin can
+        # initialize its backend through get_backend() paths that ignore
+        # the config alone (observed: jax.default_backend() hanging on a
+        # downed tunnel despite jax_platforms="cpu")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _RUNNER, path],
+            cwd=os.path.join(EXAMPLES_DIR, ".."),
+            env=env, capture_output=True, text=True, timeout=600)
+        dt = time.perf_counter() - t0
+        status = "ok  " if proc.returncode == 0 else "FAIL"
+        print(f"{status} {f:<28} {dt:6.1f}s")
+        if proc.returncode != 0:
+            failures.append(f)
+            print(proc.stderr[-1500:])
+    if failures:
+        print(f"{len(failures)} example(s) failed: {failures}")
+        return 1
+    print(f"all {len(files)} examples pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
