@@ -174,3 +174,26 @@ class TestIirFuzz:
         got = np.concatenate(outs)
         want = np.asarray(ops.sosfilt(x, sos))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+class TestSosfreqz:
+    def test_matches_scipy(self):
+        sos = _sos(6, 0.25)
+        w_ref, h_ref = ops.sosfreqz(sos, 256, impl="reference")
+        w, h = ops.sosfreqz(sos, 256)
+        np.testing.assert_allclose(np.asarray(w), w_ref, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h), h_ref, atol=1e-4)
+
+    def test_filter_matches_response(self, rng):
+        """|H| at a tone's frequency predicts sosfilt's steady-state
+        gain — closes the design->filter->verify loop."""
+        sos = _sos(6, 0.3)
+        f = 0.1  # cycles/sample; passband
+        n = 8192
+        x = np.sin(2 * np.pi * f * np.arange(n)).astype(np.float32)
+        y = np.asarray(ops.sosfilt(x, sos))
+        gain = np.std(y[2000:]) / np.std(x[2000:])
+        w, h = ops.sosfreqz(sos, 4096)
+        # grid excludes pi: bin k is at w = pi*k/4096
+        hi = np.abs(np.asarray(h))[int(round(f * 2 * 4096))]
+        np.testing.assert_allclose(gain, hi, rtol=1e-2)

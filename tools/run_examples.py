@@ -27,6 +27,11 @@ def main():
                    if f.endswith(".py"))
     if names:
         files = [f for f in files if f[:-3] in names or f in names]
+        missing = [n for n in names
+                   if n not in [f[:-3] for f in files] + files]
+        if missing:
+            print(f"unknown example(s): {missing}")
+            return 2
     failures = []
     for f in files:
         path = os.path.join(EXAMPLES_DIR, f)
@@ -36,16 +41,22 @@ def main():
         # the config alone (observed: jax.default_backend() hanging on a
         # downed tunnel despite jax_platforms="cpu")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        proc = subprocess.run(
-            [sys.executable, "-c", _RUNNER, path],
-            cwd=os.path.join(EXAMPLES_DIR, ".."),
-            env=env, capture_output=True, text=True, timeout=600)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _RUNNER, path],
+                cwd=os.path.join(EXAMPLES_DIR, ".."),
+                env=env, capture_output=True, text=True, timeout=600)
+            rc, stderr = proc.returncode, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = -1
+            stderr = ((e.stderr or "") if isinstance(e.stderr, str)
+                      else "") + "\n[timed out after 600s]"
         dt = time.perf_counter() - t0
-        status = "ok  " if proc.returncode == 0 else "FAIL"
+        status = "ok  " if rc == 0 else "FAIL"
         print(f"{status} {f:<28} {dt:6.1f}s")
-        if proc.returncode != 0:
+        if rc != 0:
             failures.append(f)
-            print(proc.stderr[-1500:])
+            print(stderr[-1500:])
     if failures:
         print(f"{len(failures)} example(s) failed: {failures}")
         return 1

@@ -43,7 +43,7 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate_simd)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, butter_sos, iir_stream_init, iir_stream_step, sosfilt,
-    sosfiltfilt)
+    sosfiltfilt, sosfreqz)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401

@@ -134,6 +134,33 @@ def butter_sos(order, wn, btype="lowpass"):
     return butter(order, wn, btype=btype, output="sos")
 
 
+@functools.partial(jax.jit, static_argnames=("n_freqs",))
+def _sosfreqz_xla(sos, n_freqs):
+    sos = jnp.asarray(sos, jnp.float32)
+    # scipy grid convention: endpoint excluded, w in [0, pi)
+    w = jnp.linspace(0.0, jnp.pi, n_freqs, endpoint=False)
+    z1 = jnp.exp(-1j * w)  # z^-1 on the unit circle
+    z2 = z1 * z1
+    num = (sos[:, 0, None] + sos[:, 1, None] * z1
+           + sos[:, 2, None] * z2)
+    den = (sos[:, 3, None] + sos[:, 4, None] * z1
+           + sos[:, 5, None] * z2)
+    return w, jnp.prod(num / den, axis=0)
+
+
+def sosfreqz(sos, n_freqs=512, *, impl=None):
+    """Frequency response of a biquad cascade -> (w, H) with ``w`` on
+    scipy's grid [0, pi) (radians/sample, endpoint excluded) and complex
+    ``H`` — the design-verification companion of butter_sos
+    (scipy.signal.sosfreqz semantics at ``whole=False``)."""
+    sos = _check_sos(sos)  # same contract on every backend
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        from scipy.signal import sosfreqz as _sosfreqz
+        return _sosfreqz(np.asarray(sos, np.float64), worN=n_freqs)
+    return _sosfreqz_xla(sos, int(n_freqs))
+
+
 # ---------------------------------------------------------------------------
 # streaming
 # ---------------------------------------------------------------------------
