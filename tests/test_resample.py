@@ -150,3 +150,20 @@ class TestResampleFuzz:
         np.testing.assert_allclose(
             got / scale, want / scale, atol=5e-5,
             err_msg=f"seed={seed} up={up} down={down} n={n} m={m}")
+
+
+def test_identity_ratio_returns_input(rng):
+    x = rng.normal(size=100).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(ops.resample_poly(x, 1, 1)), x)
+    # gcd reduction: 3/3 is the identity too
+    np.testing.assert_array_equal(np.asarray(ops.resample_poly(x, 3, 3)), x)
+    with pytest.raises(ValueError, match="identity"):
+        ops.resample_filter(1, 1)
+
+
+def test_stream_step_rejects_bad_factors():
+    h = np.ones(5, np.float32)
+    st = ops.resample_stream_init(h, 2, 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        ops.resample_stream_step(st, np.zeros(8, np.float32), h,
+                                 up=2, down=0)

@@ -66,13 +66,21 @@ def main():
                       null_carry=a[:8, :8],
                       attempts=3 if on_tpu else 1, attempt_gap_s=2.0)
     flops = 2 * n**3
-    xla_g = flops / sts["xla"]["sec"] / 1e9
+
+    def best_sec(st):  # corrected when real, raw otherwise (floored)
+        return st["sec"] if st["sec"] == st["sec"] else st["raw_sec"]
+
+    xla_g = flops / best_sec(sts["xla"]) / 1e9
     print(f"{'config':>24} {'TFLOPS':>8} {'raw':>8} {'vs xla':>7}")
-    for name, st in sorted(sts.items(), key=lambda kv: kv[1]["sec"]):
-        g = flops / st["sec"] / 1e9
+    for name, st in sorted(sts.items(), key=lambda kv: best_sec(kv[1])):
+        g = flops / best_sec(st) / 1e9
         graw = flops / st["raw_sec"] / 1e9
+        floored = "*" if st["sec"] != st["sec"] else " "
         print(f"{name:>24} {g / 1e3:8.1f} {graw / 1e3:8.1f} "
-              f"{g / xla_g:7.3f}")
+              f"{g / xla_g:7.3f}{floored}")
+    if any(st["sec"] != st["sec"] for st in sts.values()):
+        print("* floored config: corrected time indistinguishable from "
+              "the RTT floor; raw wall-clock shown")
 
 
 if __name__ == "__main__":

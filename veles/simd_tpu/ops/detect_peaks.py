@@ -35,12 +35,12 @@ from veles.simd_tpu.reference.detect_peaks import (  # noqa: F401 (re-export)
 
 # one-hot-matvec compaction wins below this capacity; full-row sort above
 _ONEHOT_COMPACT_MAX_CAP = 128
-# ...and only while flat indices are exact in the float32 iota/einsum
-# AND the (capacity, m) one-hot stays a reasonable intermediate; above
-# this the sort path is both the safe and the sane choice (the 2-D op
-# can flatten megapixel interiors — m = (H-2)*(W-2) reaches 2^24, where
-# float32 rounds odd indices to even and coordinates silently corrupt)
-_ONEHOT_COMPACT_MAX_M = 1 << 22
+# ...and only while the (capacity, m) one-hot stays a reasonable
+# intermediate (128 x 2^18 f32 = 134 MB, fusable; megapixel interiors
+# would reach GiB) and flat indices stay far below 2^24, where the
+# float32 iota rounds odd indices to even and coordinates silently
+# corrupt. Above the cap the sort path is both safe and cheap.
+_ONEHOT_COMPACT_MAX_M = 1 << 18
 
 
 def _select_extrema(data, extremum_type):

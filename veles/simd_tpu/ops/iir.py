@@ -153,12 +153,12 @@ def sosfreqz(sos, n_freqs=512, *, impl=None):
     scipy's grid [0, pi) (radians/sample, endpoint excluded) and complex
     ``H`` — the design-verification companion of butter_sos
     (scipy.signal.sosfreqz semantics at ``whole=False``)."""
-    sos = _check_sos(sos)  # same contract on every backend
-    impl = resolve_impl(impl)
+    sos64 = _ref._check_sos(sos)  # same contract on every backend;
+    impl = resolve_impl(impl)     # the oracle stays float64
     if impl == "reference":
         from scipy.signal import sosfreqz as _sosfreqz
-        return _sosfreqz(np.asarray(sos, np.float64), worN=n_freqs)
-    return _sosfreqz_xla(sos, int(n_freqs))
+        return _sosfreqz(sos64, worN=n_freqs)
+    return _sosfreqz_xla(sos64.astype(np.float32), int(n_freqs))
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,7 @@ Oracle: reference/resample.py (float64 zero-stuff definition).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +98,11 @@ def resample_filter(up, down, taps_per_phase=16, beta=8.0):
     from scipy.signal import firwin
 
     max_rate = max(up, down)
+    if max_rate < 2:
+        raise ValueError(
+            "up == down == 1 is the identity ratio: no anti-alias filter "
+            "exists (cutoff would sit at Nyquist); resample_poly returns "
+            "the input unchanged for it")
     m = 2 * taps_per_phase * max_rate + 1
     h = firwin(m, 1.0 / max_rate, window=("kaiser", beta))
     return (h * up).astype(np.float64)
@@ -112,6 +118,14 @@ def resample_poly(x, up, down, h=None, *, impl=None):
     """
     if up < 1 or down < 1:
         raise ValueError("up and down must be >= 1")
+    # rate semantics are gcd-invariant (output length ceil(n*up/down),
+    # alignment t*down/up) — reduce like scipy.signal.resample_poly, and
+    # short-circuit the identity ratio (no filter needed or designable)
+    g = math.gcd(int(up), int(down))
+    up, down = int(up) // g, int(down) // g
+    if up == 1 and down == 1 and h is None:
+        x = jnp.asarray(x, jnp.float32)
+        return x
     if h is None:
         h = resample_filter(up, down)
     if resolve_impl(impl) == "reference":

@@ -142,6 +142,8 @@ def resample_stream_step(state: ResampleStreamState, chunk, h, up=1,
     ops/resample.py: per-phase VALID correlations over the carry-extended
     block, phases interleaved at the up rate, then the ``down`` stride.
     """
+    if up < 1 or down < 1:
+        raise ValueError("up and down must be >= 1")
     chunk = jnp.asarray(chunk, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     m = h.shape[-1]
