@@ -1,7 +1,13 @@
 #!/bin/bash
-# Poll the axon TPU tunnel; when it answers, run the full on-chip
-# validation + measurement sequence and log everything. Detach with:
+# Poll the axon TPU tunnel; when it answers, run the on-chip validation
+# + measurement sequence and log everything. Detach with:
 #   nohup bash tools/await_tpu.sh > /tmp/tpu_watch.log 2>&1 &
+#
+# BOUNDED by default: the tunnel connection is EXCLUSIVE, so a watcher
+# that outlives its operator can starve the driver's end-of-round bench.
+# The poll loop gives up after $VELES_WATCH_DEADLINE_S seconds (default
+# 90 min) and exits clean; the work phase itself is timeout-capped.
+#
 # Outputs land under /tmp (kept out of the repo):
 #   /tmp/tpu_watch.log        - progress + summaries
 #   /tmp/tpu_suite.log        - full VELES_TEST_TPU pytest output
@@ -10,30 +16,32 @@
 set -u
 cd /root/repo
 
+DEADLINE=$(( $(date +%s) + ${VELES_WATCH_DEADLINE_S:-5400} ))
 echo "[watch] start $(date -u +%H:%M:%S)"
-while true; do
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 150 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
-    break
+    echo "[watch] TPU UP at $(date -u +%H:%M:%S)"
+
+    echo "[watch] === tpu_smoke ==="
+    timeout 1800 python tools/tpu_smoke.py 2>&1 | tail -15
+
+    echo "[watch] === tune_matmul sweep ==="
+    timeout 2400 python tools/tune_matmul.py > /tmp/tune_matmul.log 2>&1
+    tail -25 /tmp/tune_matmul.log
+
+    echo "[watch] === bench.py ==="
+    timeout 2400 python bench.py > /tmp/bench_preview.json 2>/tmp/bench_err.log
+    cat /tmp/bench_preview.json
+
+    echo "[watch] === VELES_TEST_TPU suite ==="
+    timeout 3600 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
+      > /tmp/tpu_suite.log 2>&1
+    tail -3 /tmp/tpu_suite.log
+
+    echo "[watch] DONE $(date -u +%H:%M:%S)"
+    exit 0
   fi
   echo "[watch] tunnel down $(date -u +%H:%M:%S)"
   sleep 45
 done
-echo "[watch] TPU UP at $(date -u +%H:%M:%S)"
-
-echo "[watch] === tpu_smoke ==="
-timeout 1800 python tools/tpu_smoke.py 2>&1 | tail -15
-
-echo "[watch] === VELES_TEST_TPU suite ==="
-timeout 3600 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
-  > /tmp/tpu_suite.log 2>&1
-tail -3 /tmp/tpu_suite.log
-
-echo "[watch] === tune_matmul sweep ==="
-timeout 2400 python tools/tune_matmul.py > /tmp/tune_matmul.log 2>&1
-tail -25 /tmp/tune_matmul.log
-
-echo "[watch] === bench.py ==="
-timeout 2400 python bench.py > /tmp/bench_preview.json 2>/tmp/bench_err.log
-cat /tmp/bench_preview.json
-
-echo "[watch] DONE $(date -u +%H:%M:%S)"
+echo "[watch] deadline reached with tunnel down; exiting clean"
