@@ -89,8 +89,9 @@ def test_chain_stats_keys_and_ordering():
     sts = chain_stats({"mm": lambda c: c @ c * 1e-3}, carry,
                       iters=16, reps=2, on_floor="nan")
     st = sts["mm"]
-    assert set(st) == {"sec", "raw_sec", "floor_sec"}
+    assert set(st) == {"sec", "raw_sec", "floor_sec", "attempt_sec"}
     assert st["raw_sec"] > 0 and st["floor_sec"] > 0
+    assert len(st["attempt_sec"]) == 1  # attempts defaults to 1
     if math.isfinite(st["sec"]):
         assert st["raw_sec"] >= st["sec"]
 

@@ -184,6 +184,19 @@ class TestSosfreqz:
         np.testing.assert_allclose(np.asarray(w), w_ref, atol=1e-6)
         np.testing.assert_allclose(np.asarray(h), h_ref, atol=1e-4)
 
+    def test_high_order_stopband_accuracy(self):
+        """Order-12 cascade, deep stopband: the float64 host evaluation
+        (ADVICE r2) must hold RELATIVE accuracy against scipy where the
+        magnitude sits ~100 dB down — complex64 per-section products
+        could not."""
+        sos = _sos(12, 0.2)
+        w_ref, h_ref = ops.sosfreqz(sos, 1024, impl="reference")
+        w, h = ops.sosfreqz(sos, 1024)
+        stop = w_ref > 0.6 * np.pi  # deep stopband bins
+        assert np.abs(h_ref[stop]).max() < 1e-4  # the regime under test
+        np.testing.assert_allclose(np.asarray(h)[stop], h_ref[stop],
+                                   rtol=1e-9)
+
     def test_filter_matches_response(self, rng):
         """|H| at a tone's frequency predicts sosfilt's steady-state
         gain — closes the design->filter->verify loop."""

@@ -63,6 +63,23 @@ def test_add_sub(impl, rng):
                                rtol=1e-6)
 
 
+@pytest.mark.parametrize("transpose", [False, True])
+def test_pallas_f32_precision_path(transpose, rng):
+    """ADVICE r2: impl='pallas' regained an f32-accurate product via
+    precision='highest' (full-width operands through the in-kernel dot) —
+    pinned at the xla-HIGHEST tolerance, not the bf16 0.1 epsilon."""
+    m1 = rng.normal(size=(99, 35)).astype(np.float32)
+    m2 = rng.normal(size=(77, 35) if transpose else (35, 77)).astype(
+        np.float32)
+    fn = (ops.matrix_multiply_transposed if transpose
+          else ops.matrix_multiply)
+    ref = fn(m1, m2, impl="reference")
+    got = np.asarray(fn(m1, m2, impl="pallas", precision="highest"))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-4)
+    with pytest.raises(ValueError):
+        fn(m1, m2, impl="pallas", precision="high")
+
+
 def test_multiply_golden():
     m1 = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
     m2 = np.array([[5.0, 6.0], [7.0, 8.0]], dtype=np.float32)

@@ -157,6 +157,11 @@ def test_identity_ratio_returns_input(rng):
     np.testing.assert_array_equal(np.asarray(ops.resample_poly(x, 1, 1)), x)
     # gcd reduction: 3/3 is the identity too
     np.testing.assert_array_equal(np.asarray(ops.resample_poly(x, 3, 3)), x)
+    # scipy's up==down short-circuit precedes window handling: an
+    # explicitly supplied h must not break the identity (ADVICE r2)
+    h = rng.normal(size=31).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ops.resample_poly(x, 2, 2, h=h)), x)
     with pytest.raises(ValueError, match="identity"):
         ops.resample_filter(1, 1)
 

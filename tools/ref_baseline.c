@@ -1,0 +1,216 @@
+/* Measured AVX baseline for BASELINE.md (VERDICT r2 item 3).
+ *
+ * Times the reference library's public API (simd/{matrix,convolve,wavelet,
+ * normalize,detect_peaks}.h, arithmetic-inl.h) at exactly the shapes our
+ * bench configs use (utils/bench_extra.py + bench.py headline), compiled
+ * -O3 -march=native with simd=1, so the "reference AVX (measured)" column
+ * is the library's real accelerated path on this host — not the NumPy
+ * stand-in utils/speedup.py used before.
+ *
+ * Build + run: bash tools/ref_baseline.sh  (writes REF_BASELINE.json).
+ * Timing: monotonic clock, best total of REPS groups / iters — single
+ * process, single core (this box has nproc=1; the reference library is
+ * single-threaded by design, src/matrix.c:200-252 etc.).
+ */
+#define _POSIX_C_SOURCE 199309L
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <simd/arithmetic-inl.h>
+#include <simd/convolve.h>
+#include <simd/detect_peaks.h>
+#include <simd/matrix.h>
+#include <simd/memory.h>
+#include <simd/normalize.h>
+#include <simd/wavelet.h>
+
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static float *rand_f32(size_t n, unsigned seed) {
+  float *p = malloc_aligned(n * sizeof(float));
+  srand(seed);
+  for (size_t i = 0; i < n; i++)
+    p[i] = (rand() / (float)RAND_MAX - 0.5f) * 2.0f;
+  return p;
+}
+
+/* best-of-REPS total seconds for iters calls of fn(ctx) */
+#define REPS 3
+typedef void (*bench_fn)(void *);
+static double best_time(bench_fn fn, void *ctx, int iters) {
+  double best = 1e30;
+  for (int r = 0; r < REPS; r++) {
+    double t0 = now_s();
+    for (int i = 0; i < iters; i++) fn(ctx);
+    double dt = now_s() - t0;
+    if (dt < best) best = dt;
+  }
+  return best / iters;
+}
+
+/* ---- matmul 4096 (bench.py headline shape) ---- */
+struct mm_ctx { const float *a, *b; float *r; size_t n; int transposed; };
+static void mm_run(void *v) {
+  struct mm_ctx *c = v;
+  if (c->transposed)
+    matrix_multiply_transposed(1, c->a, c->b, c->n, c->n, c->n, c->n, c->r);
+  else
+    matrix_multiply(1, c->a, c->b, c->n, c->n, c->n, c->n, c->r);
+}
+
+/* ---- elementwise (c+c)*0.25f + 0.5f, n=1M (bench_elementwise) ----
+ * The reference expresses this as three separate SIMD kernel passes
+ * (its programming model: one exported kernel per op). */
+struct ew_ctx { const float *x; float *y; size_t n; };
+static void ew_run(void *v) {
+  struct ew_ctx *c = v;
+  real_multiply_scalar(c->x, c->n, 2.0f, c->y);
+  real_multiply_scalar(c->y, c->n, 0.25f, c->y);
+  add_to_all(c->y, c->n, 0.5f, c->y);
+}
+
+/* ---- convolve n=65536 m=127 (bench_convolve) ----
+ * With FFTF absent (NO_FFTF) the library's accelerated path is the AVX
+ * brute-force kernel (src/convolve.c:202-310); convolve_initialize would
+ * select the same. */
+struct cv_ctx { const float *x, *h; float *r; size_t n, m; };
+static void cv_run(void *v) {
+  struct cv_ctx *c = v;
+  convolve_simd(1, c->x, c->n, c->h, c->m, c->r);
+}
+
+/* ---- batched convolve 64 x 16384, m=127 (bench_convolve_batched) ---- */
+struct cvb_ctx { const float *x, *h; float *r; size_t b, n, m; };
+static void cvb_run(void *v) {
+  struct cvb_ctx *c = v;
+  for (size_t i = 0; i < c->b; i++)
+    convolve_simd(1, c->x + i * c->n, c->n, c->h, c->m, c->r);
+}
+
+/* ---- DWT db8 6-level cascade, n=262144 (bench_dwt) ----
+ * wavelet_apply halves length each level, highpass discarded like the
+ * bench's cascade; buffers via the library's own prepare/allocate. */
+struct dwt_ctx { float *prep; float *hi, *lo; size_t n; int levels; };
+static void dwt_run(void *v) {
+  struct dwt_ctx *c = v;
+  size_t len = c->n;
+  const float *src = c->prep;
+  for (int l = 0; l < c->levels; l++) {
+    wavelet_apply(WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_PERIODIC,
+                  src, len, c->hi, c->lo);
+    src = c->lo;
+    len /= 2;
+  }
+}
+
+/* ---- normalize + detect_peaks, 256 x 4096 (bench_batched_pipeline) ----
+ * minmax1D + two-pass affine rescale + peak extraction per signal; the
+ * malloc/free per call is the library's own contract
+ * (detect_peaks.h:55-63). */
+struct np_ctx { const float *x; float *y; size_t b, n; };
+static void np_run(void *v) {
+  struct np_ctx *c = v;
+  for (size_t i = 0; i < c->b; i++) {
+    const float *sig = c->x + i * c->n;
+    float mn, mx;
+    minmax1D(1, sig, (int)c->n, &mn, &mx);
+    float scale = (mx > mn) ? 2.0f / (mx - mn) : 0.0f;
+    real_multiply_scalar(sig, c->n, scale, c->y);
+    add_to_all(c->y, c->n, -(mn * scale) - 1.0f, c->y);
+    ExtremumPoint *pts = NULL;
+    size_t npts = 0;
+    detect_peaks(1, c->y, c->n, kExtremumTypeMaximum, &pts, &npts);
+    free(pts);
+  }
+}
+
+static void emit(const char *metric, double sec, double work,
+                 const char *unit, double divisor) {
+  printf("{\"metric\": \"%s\", \"value\": %.2f, \"unit\": \"%s\", "
+         "\"sec_per_call\": %.6g}\n",
+         metric, work / sec / divisor, unit, sec);
+  fflush(stdout);
+}
+
+int main(void) {
+  /* matmul: one 4096 call is seconds-scale on one core; iters=1 x REPS */
+  {
+    size_t n = 4096;
+    struct mm_ctx c = {rand_f32(n * n, 1), rand_f32(n * n, 2),
+                       malloc_aligned(n * n * sizeof(float)), n, 0};
+    double plain = best_time(mm_run, &c, 1);
+    c.transposed = 1;
+    double trans = best_time(mm_run, &c, 1);
+    double best = plain < trans ? plain : trans;
+    emit("matrix_multiply_f32_n4096", best, 2.0 * n * n * n, "GFLOPS", 1e9);
+    printf("{\"metric\": \"matrix_multiply_f32_n4096_transposed\", "
+           "\"value\": %.2f, \"unit\": \"GFLOPS\"}\n",
+           2.0 * n * n * n / trans / 1e9);
+    free(/*cast away const for free*/ (void *)c.a);
+    free((void *)c.b);
+    free(c.r);
+  }
+  {
+    size_t n = 1000000;
+    struct ew_ctx c = {rand_f32(n, 3), malloc_aligned(n * sizeof(float)), n};
+    double sec = best_time(ew_run, &c, 200);
+    emit("elementwise_add_mul_scale_n1000000", sec, 3.0 * n, "Gop/s", 1e9);
+    free((void *)c.x);
+    free(c.y);
+  }
+  {
+    size_t n = 65536, m = 127;
+    /* convolve_simd writes the FULL linear convolution (n+m-1 floats;
+     * the loop in src/convolve.c:49, despite the header's "length
+     * xLength" comment) */
+    struct cv_ctx c = {rand_f32(n, 4), rand_f32(m, 5),
+                       malloc_aligned((n + m) * sizeof(float)), n, m};
+    double sec = best_time(cv_run, &c, 20);
+    emit("convolve_n65536_m127", sec, (double)n, "MSamples/s", 1e6);
+    free((void *)c.x);
+    free((void *)c.h);
+    free(c.r);
+  }
+  {
+    size_t b = 64, n = 16384, m = 127;
+    struct cvb_ctx c = {rand_f32(b * n, 6), rand_f32(m, 7),
+                        malloc_aligned((n + m) * sizeof(float)), b, n, m};
+    double sec = best_time(cvb_run, &c, 5);
+    emit("convolve_batched_b64_n16384_m127", sec, (double)(b * n),
+         "MSamples/s", 1e6);
+    free((void *)c.x);
+    free((void *)c.h);
+    free(c.r);
+  }
+  {
+    size_t n = 262144;
+    float *raw = rand_f32(n, 8);
+    struct dwt_ctx c = {wavelet_prepare_array(8, raw, n),
+                        wavelet_allocate_destination(8, n),
+                        wavelet_allocate_destination(8, n), n, 6};
+    double sec = best_time(dwt_run, &c, 50);
+    emit("dwt_db8_6level_n262144", sec, (double)n, "MSamples/s", 1e6);
+    free(raw);
+    free(c.prep);
+    free(c.hi);
+    free(c.lo);
+  }
+  {
+    size_t b = 256, n = 4096;
+    struct np_ctx c = {rand_f32(b * n, 9), malloc_aligned(n * sizeof(float)),
+                       b, n};
+    double sec = best_time(np_run, &c, 10);
+    emit("normalize_peaks_b256_n4096", sec, (double)(b * n),
+         "MSamples/s", 1e6);
+    free((void *)c.x);
+    free(c.y);
+  }
+  return 0;
+}

@@ -1,0 +1,82 @@
+#!/bin/bash
+# Build the reference library out-of-tree (-O3 -march=native, NO_FFTF) and
+# run tools/ref_baseline.c against it; record the measured AVX numbers in
+# REF_BASELINE.json. /root/reference stays untouched (VERDICT r2 item 3).
+set -eu
+REF=${VELES_REF:-/root/reference}
+BUILD=${VELES_REF_BUILD:-/tmp/refbuild}
+OUT=${1:-REF_BASELINE.json}
+
+mkdir -p "$BUILD"
+
+# convolve.c / correlate.c are wholly gated on FFTF (src/convolve.c:31),
+# but their brute-force AVX kernels (convolve_simd / cross_correlate_simd)
+# never touch it. The FFTF library is absent on this box, so generate a
+# minimal stub (declarations inferred from the call sites; aborts if an
+# FFT path is actually entered) to unlock the brute kernels for timing.
+mkdir -p "$BUILD/fftf-stub/fftf"
+cat > "$BUILD/fftf-stub/fftf/api.h" <<'EOF'
+#ifndef FFTF_STUB_API_H_
+#define FFTF_STUB_API_H_
+#define FFTF_TYPE_REAL 0
+#define FFTF_DIRECTION_FORWARD 1
+#define FFTF_DIRECTION_BACKWARD 2
+#define FFTF_DIMENSION_1D 1
+#define FFTF_NO_OPTIONS 0
+typedef struct FFTFInstance FFTFInstance;
+/* unprototyped on purpose: the stub satisfies the linker, not the ABI */
+FFTFInstance *fftf_init();
+FFTFInstance *fftf_init_batch();
+void fftf_destroy();
+void fftf_calc();
+#endif
+EOF
+cat > "$BUILD/fftf-stub/fftf_stub.c" <<'EOF'
+#include <stdio.h>
+#include <stdlib.h>
+static void *die(void) {
+  fprintf(stderr, "fftf stub called: FFT paths are unavailable in this "
+                  "baseline build\n");
+  abort();
+}
+void *fftf_init(void) { return die(); }
+void *fftf_init_batch(void) { return die(); }
+void fftf_destroy(void) { die(); }
+void fftf_calc(void) { die(); }
+EOF
+
+for f in "$REF"/src/*.c; do
+  base="$(basename "${f%.c}")"
+  o="$BUILD/$base.o"
+  case "$base" in
+    convolve|correlate) extra="-I$BUILD/fftf-stub" ;;
+    *) extra="-DNO_FFTF" ;;
+  esac
+  [ "$o" -nt "$f" ] 2>/dev/null || \
+    gcc -O3 -march=native -std=gnu99 -fPIC -I"$REF" -I"$REF/inc" \
+        $extra -c "$f" -o "$o"
+done
+gcc -O3 -c "$BUILD/fftf-stub/fftf_stub.c" -o "$BUILD/fftf_stub.o"
+ar rcs "$BUILD/libSimd.a" "$BUILD"/*.o
+gcc -O3 -march=native -std=gnu99 -I"$REF" -I"$REF/inc" -DNO_FFTF \
+    tools/ref_baseline.c "$BUILD/libSimd.a" -lm -o "$BUILD/ref_baseline"
+
+echo "[ref_baseline] running (single core; matmul reps are seconds-scale)..."
+"$BUILD/ref_baseline" | tee /tmp/ref_baseline_lines.json
+
+python - "$OUT" <<'EOF'
+import json, subprocess, sys
+lines = [json.loads(l) for l in open("/tmp/ref_baseline_lines.json")]
+cpu = ""
+for l in open("/proc/cpuinfo"):
+    if l.startswith("model name"):
+        cpu = l.split(":", 1)[1].strip(); break
+nproc = subprocess.run(["nproc"], capture_output=True, text=True).stdout.strip()
+rec = {"provenance": "tools/ref_baseline.c vs /root/reference built "
+                     "-O3 -march=native -DNO_FFTF (tools/ref_baseline.sh)",
+       "cpu": cpu, "cores_available": int(nproc), "simd_flag": 1,
+       "configs": {l["metric"]: {k: v for k, v in l.items()
+                                 if k != "metric"} for l in lines}}
+json.dump(rec, open(sys.argv[1], "w"), indent=1)
+print(f"[ref_baseline] wrote {sys.argv[1]}")
+EOF

@@ -1,30 +1,116 @@
 #!/usr/bin/env python
-"""Print the host-reference vs TPU speedup table (benchmark.inc UX).
+"""Print host-reference vs TPU speedup tables (benchmark.inc UX).
 
-Usage: python tools/speedup_table.py [--markdown]
+Two tables:
+
+  * ``--live``: the in-process NumPy-oracle vs jitted-TPU timing run
+    (utils/speedup.py) — order-of-magnitude, measured on the spot.
+  * default: the HONEST column (VERDICT r2 item 3) — the reference
+    library's own AVX kernels, built -O3 -march=native and measured by
+    tools/ref_baseline.sh into REF_BASELINE.json, joined against the
+    driver-format bench record (BENCH_r*.json or bench.py stdout) at
+    matched shapes. Metric names in both files coincide by construction.
+
+Usage:
+  python tools/speedup_table.py                 # AVX-measured vs bench
+  python tools/speedup_table.py --bench FILE    # specific bench record
+  python tools/speedup_table.py --live [--markdown]
 """
 
 import argparse
+import glob
+import json
+import os
 import sys
 
 sys.path.insert(0, ".")
+
+
+def _load_bench_record(path=None):
+    """Newest parseable bench record: explicit path, else BENCH_r*.json
+    (driver artifact, newest first), else /tmp/bench_preview.json."""
+    candidates = ([path] if path else
+                  sorted(glob.glob("BENCH_r*.json"), reverse=True)
+                  + ["/tmp/bench_preview.json"])
+    for cand in candidates:
+        if not cand or not os.path.exists(cand):
+            continue
+        with open(cand) as f:
+            rec = json.load(f)
+        # driver artifacts wrap the stdout line under "parsed"
+        rec = rec.get("parsed", rec) or {}
+        if rec.get("value") is not None or rec.get("configs"):
+            return cand, rec
+    return None, None
+
+
+def avx_table(bench_path=None):
+    """[(metric, avx_value, tpu_value, unit, speedup)] joined by metric."""
+    with open("REF_BASELINE.json") as f:
+        ref = json.load(f)
+    src, rec = _load_bench_record(bench_path)
+    if rec is None:
+        print("no bench record with measured values found "
+              "(BENCH_r*.json all null?)", file=sys.stderr)
+        return None, []
+    tpu = {}
+    if rec.get("value") is not None:
+        tpu[rec.get("metric", "matrix_multiply_f32_n4096")] = (
+            rec["value"], rec.get("unit", ""))
+    for metric, cfg in (rec.get("configs") or {}).items():
+        if isinstance(cfg, dict) and cfg.get("value") is not None:
+            tpu[metric] = (cfg["value"], cfg.get("unit", ""))
+    rows = []
+    for metric, cfg in ref["configs"].items():
+        if metric not in tpu:
+            continue
+        tpu_v, unit = tpu[metric]
+        # units match by construction; guard anyway so a mismatch is
+        # visible in the table, not silently ratio'd away
+        ref_unit = cfg.get("unit", "")
+        tag = "" if ref_unit == unit else f" [UNITS {ref_unit} vs {unit}]"
+        rows.append((metric + tag, cfg["value"], tpu_v, unit or ref_unit,
+                     tpu_v / cfg["value"]))
+    return src, rows
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--markdown", action="store_true",
                     help="also emit a markdown table on stdout")
+    ap.add_argument("--live", action="store_true",
+                    help="run the in-process NumPy-oracle vs TPU timing "
+                         "instead of joining recorded artifacts")
+    ap.add_argument("--bench", default=None,
+                    help="bench record JSON to join against "
+                         "(default: newest BENCH_r*.json)")
     args = ap.parse_args()
 
-    from veles.simd_tpu.utils.speedup import speedup_table
+    if args.live:
+        from veles.simd_tpu.utils.speedup import speedup_table
 
-    rows = speedup_table(stream=sys.stderr)
-    if args.markdown:
-        print("| Op | host ref (ms) | TPU (ms) | speedup |")
-        print("|---|---|---|---|")
-        for name, host_s, tpu_s, speed in rows:
-            print(f"| {name} | {host_s * 1e3:.3f} | {tpu_s * 1e3:.4f} | "
-                  f"{speed:.1f}x |")
+        rows = speedup_table(stream=sys.stderr)
+        if args.markdown:
+            print("| Op | host ref (ms) | TPU (ms) | speedup |")
+            print("|---|---|---|---|")
+            for name, host_s, tpu_s, speed in rows:
+                print(f"| {name} | {host_s * 1e3:.3f} | "
+                      f"{tpu_s * 1e3:.4f} | {speed:.1f}x |")
+        return
+
+    src, rows = avx_table(args.bench)
+    if not rows:
+        if src:
+            print(f"bench record {src} shares no metric names with "
+                  f"REF_BASELINE.json (CPU smoke records use scaled-down "
+                  f"shapes; only full-scale TPU records join)",
+                  file=sys.stderr)
+        sys.exit(1)
+    print(f"# reference AVX (REF_BASELINE.json) vs TPU ({src})")
+    print("| Config | reference AVX (measured) | TPU | unit | speedup |")
+    print("|---|---|---|---|---|")
+    for metric, avx_v, tpu_v, unit, speed in rows:
+        print(f"| {metric} | {avx_v} | {tpu_v} | {unit} | {speed:,.0f}x |")
 
 
 if __name__ == "__main__":

@@ -134,31 +134,37 @@ def butter_sos(order, wn, btype="lowpass"):
     return butter(order, wn, btype=btype, output="sos")
 
 
-@functools.partial(jax.jit, static_argnames=("n_freqs",))
-def _sosfreqz_xla(sos, n_freqs):
-    sos = jnp.asarray(sos, jnp.float32)
-    # scipy grid convention: endpoint excluded, w in [0, pi)
-    w = jnp.linspace(0.0, jnp.pi, n_freqs, endpoint=False)
-    z1 = jnp.exp(-1j * w)  # z^-1 on the unit circle
+def _sosfreqz_f64(sos64, n_freqs):
+    # host-side float64 evaluation (numpy complex128): a high-order
+    # cascade's stopband sits tens of dB down, where a complex64
+    # per-section product loses relative accuracy (ADVICE r2); n_freqs
+    # is small and this op is design verification, so it belongs next
+    # to butter_sos on the host, not on the device.
+    w = np.linspace(0.0, np.pi, n_freqs, endpoint=False)
+    z1 = np.exp(-1j * w)  # z^-1 on the unit circle
     z2 = z1 * z1
-    num = (sos[:, 0, None] + sos[:, 1, None] * z1
-           + sos[:, 2, None] * z2)
-    den = (sos[:, 3, None] + sos[:, 4, None] * z1
-           + sos[:, 5, None] * z2)
-    return w, jnp.prod(num / den, axis=0)
+    num = (sos64[:, 0, None] + sos64[:, 1, None] * z1
+           + sos64[:, 2, None] * z2)
+    den = (sos64[:, 3, None] + sos64[:, 4, None] * z1
+           + sos64[:, 5, None] * z2)
+    return w, np.prod(num / den, axis=0)
 
 
 def sosfreqz(sos, n_freqs=512, *, impl=None):
     """Frequency response of a biquad cascade -> (w, H) with ``w`` on
     scipy's grid [0, pi) (radians/sample, endpoint excluded) and complex
     ``H`` — the design-verification companion of butter_sos
-    (scipy.signal.sosfreqz semantics at ``whole=False``)."""
+    (scipy.signal.sosfreqz semantics at ``whole=False``).
+
+    Evaluated host-side in float64 on every backend (like butter_sos —
+    design verification, not a device workload); ``impl="reference"``
+    delegates to scipy itself."""
     sos64 = _ref._check_sos(sos)  # same contract on every backend;
-    impl = resolve_impl(impl)     # the oracle stays float64
+    impl = resolve_impl(impl)     # the evaluation stays float64
     if impl == "reference":
         from scipy.signal import sosfreqz as _sosfreqz
         return _sosfreqz(sos64, worN=n_freqs)
-    return _sosfreqz_xla(sos64.astype(np.float32), int(n_freqs))
+    return _sosfreqz_f64(sos64, int(n_freqs))
 
 
 # ---------------------------------------------------------------------------

@@ -6,12 +6,14 @@ operands' last dims (m1 @ m2.T, matrix.c:228-252 — the reference documents
 it ~10% faster since both operands stream row-contiguously; on TPU both
 forms are a single dot_general and XLA picks the layout).
 
-``precision`` controls the MXU pass structure for float32 inputs on the xla
-impl: ``None``/DEFAULT uses fast single-pass bf16 products, ``"high"`` the
-bf16_3x scheme, ``"highest"`` the full float32 product. The pallas impl
-always runs the MXU's native bf16-product/f32-accumulation mode and rejects
-a precision argument. Differential tests run xla at HIGHEST against the
-float64 oracle; benchmarks report DEFAULT (the TPU-native operating point).
+``precision`` controls the MXU pass structure for float32 inputs. On the
+xla impl: ``None``/DEFAULT uses fast single-pass bf16 products, ``"high"``
+the bf16_3x scheme, ``"highest"`` the full float32 product. On the pallas
+impl: ``None`` runs the MXU's native bf16-product/f32-accumulation mode and
+``"highest"``/``"float32"`` keeps full-width operands through the in-kernel
+dot (~half rate) — so an f32-accurate product exists on every backend.
+Differential tests run xla at HIGHEST against the float64 oracle;
+benchmarks report DEFAULT (the TPU-native operating point).
 """
 
 from __future__ import annotations
@@ -70,12 +72,17 @@ def _mm(m1, m2, impl, precision, transpose_b):
         return ref_fn(m1, m2)
     m1, m2 = _check_mm(m1, m2, transpose_b)
     if impl == "pallas":
-        if precision is not None:
-            raise ValueError(
-                "impl='pallas' computes bf16-product/float32-accumulation "
-                "(the MXU's native mode); use impl='xla' for precision control")
         from veles.simd_tpu.pallas.matmul import matmul
-        return matmul(m1, m2, transpose_b=transpose_b)
+        if precision is None:
+            return matmul(m1, m2, transpose_b=transpose_b)
+        if precision in ("float32", "highest"):
+            # full-width in-kernel product — the f32-accurate pallas path
+            return matmul(m1, m2, transpose_b=transpose_b,
+                          precision="float32")
+        raise ValueError(
+            "impl='pallas' supports precision=None (native bf16-product/"
+            "f32-accumulation) or 'highest'/'float32' (full-width "
+            "product); intermediate XLA precisions need impl='xla'")
     return _matmul_xla(m1, m2, precision=precision, transpose_b=transpose_b)
 
 

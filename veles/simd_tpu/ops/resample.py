@@ -123,7 +123,10 @@ def resample_poly(x, up, down, h=None, *, impl=None):
     # short-circuit the identity ratio (no filter needed or designable)
     g = math.gcd(int(up), int(down))
     up, down = int(up) // g, int(down) // g
-    if up == 1 and down == 1 and h is None:
+    if up == 1 and down == 1:
+        # identity ratio returns the input unchanged even when h is
+        # supplied — scipy.signal.resample_poly's exact contract (its
+        # up==down short-circuit precedes window handling); ADVICE r2
         x = jnp.asarray(x, jnp.float32)
         return x
     if h is None:

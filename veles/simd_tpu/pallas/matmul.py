@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 from veles.simd_tpu.pallas import use_interpret
 
 
-def _make_kernel(transpose_b):
+def _make_kernel(transpose_b, f32_product):
     contract = (((1,), (1 if transpose_b else 0,)), ((), ()))
 
     def kernel(x_ref, y_ref, o_ref, acc_ref):
@@ -43,14 +43,22 @@ def _make_kernel(transpose_b):
         def _init():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        # Explicit bf16 operands: a float32 dot inside Mosaic lowers to a
-        # multi-pass product (~half rate); casting the blocks keeps the
-        # MXU in its native single-pass bf16-product/f32-accumulate mode
-        # — the same operating point as XLA's DEFAULT precision. Blocks
-        # arriving as bf16 (boundary-cast path) pass through unchanged.
+        if f32_product:
+            # precision="float32": feed the dot full-width operands and
+            # let Mosaic emit the multi-pass f32 product (the in-kernel
+            # analogue of XLA precision=HIGHEST; ~half MXU rate).
+            x_blk, y_blk = x_ref[:], y_ref[:]
+        else:
+            # Explicit bf16 operands: a float32 dot inside Mosaic lowers
+            # to a multi-pass product (~half rate); casting the blocks
+            # keeps the MXU in its native single-pass bf16-product/
+            # f32-accumulate mode — the same operating point as XLA's
+            # DEFAULT precision. Blocks arriving as bf16 (boundary-cast
+            # path) pass through unchanged.
+            x_blk = x_ref[:].astype(jnp.bfloat16)
+            y_blk = y_ref[:].astype(jnp.bfloat16)
         acc_ref[:] += jax.lax.dot_general(
-            x_ref[:].astype(jnp.bfloat16), y_ref[:].astype(jnp.bfloat16),
-            contract, preferred_element_type=jnp.float32)
+            x_blk, y_blk, contract, preferred_element_type=jnp.float32)
 
         @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
         def _flush():
@@ -59,8 +67,8 @@ def _make_kernel(transpose_b):
     return kernel
 
 
-_KERNEL_NT = _make_kernel(False)
-_KERNEL_T = _make_kernel(True)
+_KERNELS = {(tb, f32): _make_kernel(tb, f32)
+            for tb in (False, True) for f32 in (False, True)}
 
 
 def _pad_dim(a, axis, mult):
@@ -74,12 +82,13 @@ def _pad_dim(a, axis, mult):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "bm", "bn", "bk", "transpose_b", "stream_bf16"))
-def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True):
+    "bm", "bn", "bk", "transpose_b", "stream_bf16", "f32_product"))
+def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True,
+                   f32_product=False):
     m, k = x.shape
     n = y.shape[0] if transpose_b else y.shape[1]
     out_dtype = x.dtype
-    if stream_bf16 and x.dtype == jnp.float32:
+    if stream_bf16 and not f32_product and x.dtype == jnp.float32:
         # Boundary cast: blocks travel HBM->VMEM at half width, doubling
         # effective tile bandwidth; numerics are unchanged (the kernel
         # multiplies in bf16 either way, accumulating f32). The cast of a
@@ -92,7 +101,7 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True):
     else:
         y_spec = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
     return pl.pallas_call(
-        _KERNEL_T if transpose_b else _KERNEL_NT,
+        _KERNELS[(transpose_b, f32_product)],
         grid=grid,
         in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)), y_spec],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
@@ -105,15 +114,23 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True):
 
 
 def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512,
-           stream_bf16=True):
+           stream_bf16=True, precision=None):
     """x @ y (or x @ y.T) via the tiled Pallas kernel; shapes zero-padded.
 
     float32 inputs run the MXU's native bf16-product/f32-accumulation
-    mode; ``stream_bf16`` additionally casts at the pallas_call boundary
-    so HBM->VMEM block traffic is half-width. Tiles must satisfy
-    (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the ~16 MB
-    scoped VMEM budget including double buffers, or the kernel fails to
-    allocate. Defaults from the on-chip sweep (tools/tune_matmul.py)."""
+    mode by default; ``stream_bf16`` additionally casts at the
+    pallas_call boundary so HBM->VMEM block traffic is half-width.
+    ``precision="float32"`` keeps full-width operands through the dot —
+    the in-kernel analogue of impl="xla" with precision="highest" — at
+    roughly half the MXU rate (and full-width block traffic). Tiles must
+    satisfy (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the
+    ~16 MB scoped VMEM budget including double buffers, or the kernel
+    fails to allocate. Defaults from the on-chip sweep
+    (tools/tune_matmul.py)."""
+    if precision not in (None, "bf16", "float32"):
+        raise ValueError(
+            f"precision must be None, 'bf16' or 'float32', got {precision!r}")
+    f32_product = precision == "float32"
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     inner = y.shape[-1] if transpose_b else y.shape[0]
@@ -133,7 +150,7 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512,
     else:
         yp = _pad_dim(_pad_dim(y, 0, bk_), 1, bn_)
     out = _matmul_padded(xp, yp, bm_, bn_, bk_, transpose_b,
-                         stream_bf16=stream_bf16)
+                         stream_bf16=stream_bf16, f32_product=f32_product)
     return out[:m, :n]
 
 

@@ -45,7 +45,11 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     null chain) are compiled up front, then timed interleaved; returns
     {name: {"sec": corrected_seconds_per_step,
             "raw_sec": uncorrected_seconds_per_step,
-            "floor_sec": paired_floor_seconds_per_step}}.
+            "floor_sec": paired_floor_seconds_per_step,
+            "attempt_sec": [per-attempt corrected seconds]}}.
+    ``attempt_sec`` carries one paired-floor-corrected value per spaced
+    attempt group (NaN where that group floored) so the emitted record
+    can show the spread across chip-state drift, not just the best point.
     ``raw_sec`` is the best total wall-clock divided by ``iters`` with no
     floor subtraction — the unimpeachable lower bound on rate claims.
     Raises on non-finite checksums. A config whose total is
@@ -101,12 +105,30 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     # min-over-paired-diffs would preferentially pick high-floor
     # outliers and inflate rates again).
     floors = totals.pop("__null__")
-    out = {}
-    for name, series in totals.items():
-        idx = min(range(len(series)), key=series.__getitem__)
-        best_total, best_floor = series[idx], min(floors)
+
+    def corrected(series, lo, hi):
+        """Best paired-floor-corrected total in series[lo:hi], or NaN when
+        that window is floored (same criterion as the headline value)."""
+        idx = min(range(lo, hi), key=series.__getitem__)
+        best_total = series[idx]
         best_diff = best_total - floors[idx]
-        if best_total <= best_floor * 1.05 or best_diff <= 0:
+        if best_total <= min(floors[lo:hi]) * 1.05 or best_diff <= 0:
+            return float("nan"), idx
+        return best_diff, idx
+
+    out = {}
+    n_attempts = max(attempts, 1)
+    for name, series in totals.items():
+        best_diff, idx = corrected(series, 0, len(series))
+        best_total, best_floor = series[idx], min(floors)
+        # per-attempt corrected values: the spread across chip-state
+        # drift that a single clamped point estimate hides
+        attempt_sec = []
+        for a in range(n_attempts):
+            lo, hi = a * reps, (a + 1) * reps
+            d, _ = corrected(series, lo, hi)
+            attempt_sec.append(d / iters)
+        if best_diff != best_diff:  # floored overall
             msg = (f"config '{name}' ({best_total * 1e3:.1f} ms) is "
                    f"indistinguishable from the RTT floor "
                    f"({best_floor * 1e3:.1f} ms); raise iters so device "
@@ -115,11 +137,13 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
                 raise RuntimeError(msg)
             out[name] = {"sec": float("nan"),
                          "raw_sec": best_total / iters,
-                         "floor_sec": floors[idx] / iters}
+                         "floor_sec": floors[idx] / iters,
+                         "attempt_sec": attempt_sec}
         else:
             out[name] = {"sec": best_diff / iters,
                          "raw_sec": best_total / iters,
-                         "floor_sec": floors[idx] / iters}
+                         "floor_sec": floors[idx] / iters,
+                         "attempt_sec": attempt_sec}
     return out
 
 
