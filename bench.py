@@ -237,6 +237,7 @@ def supervise(headline_only_run: bool = False, *, plans=None,
                 (True, 480, 30),
             ]
 
+    import shutil
     import tempfile
     progress_dir = tempfile.mkdtemp(prefix="veles_bench_")
     progress_paths = []
@@ -310,6 +311,9 @@ def supervise(headline_only_run: bool = False, *, plans=None,
                 if partial.get("configs"):
                     result.setdefault("configs", partial["configs"])
             print(json.dumps(result))
+            # success: the progress stream duplicates the stdout record;
+            # on failure the directory is left behind for debugging
+            shutil.rmtree(progress_dir, ignore_errors=True)
             return 0
         last_err = (f"worker rc={proc.returncode}; "
                     f"stderr tail: {proc.stderr[-1200:]}")

@@ -13,10 +13,12 @@ tests pin the two fixes with fake workers and tiny timeouts:
 """
 
 import json
+import os
 import sys
 import textwrap
 
-sys.path.insert(0, "/root/repo")  # bench.py lives at the repo root
+# bench.py lives at the repo root (one level above tests/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 # generous timeouts: this box has one core, and a concurrent build can

@@ -197,8 +197,10 @@ int main(void) {
                         wavelet_allocate_destination(8, n), n, 6};
     double sec = best_time(dwt_run, &c, 50);
     emit("dwt_db8_6level_n262144", sec, (double)n, "MSamples/s", 1e6);
+    /* without AVX, wavelet_prepare_array returns src itself
+     * (wavelet.h:53-55) — guard against a double free */
+    if (c.prep != raw) free(c.prep);
     free(raw);
-    free(c.prep);
     free(c.hi);
     free(c.lo);
   }

@@ -380,9 +380,47 @@ def bench_iir(scale=1):
             **_msps(st, batch * n)}
 
 
+def bench_iir_long(scale=1):
+    """Long-signal IIR, flat vs blocked associative scan (VERDICT r2
+    item 5): 16 signals x 262144 samples through butterworth-6. The flat
+    tree broadcasts the 2x2 companion matrix to every sample; the
+    blocked form scans 4096-sample chunks sequentially — this config
+    records both so the formulation choice is a measured fact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles.simd_tpu import ops
+
+    batch, n = 16, max(int(262144 * scale), 2048)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+    sos = jnp.asarray(ops.butter_sos(6, 0.2), jnp.float32)
+
+    def make(chunk):
+        @jax.jit
+        def step(c):
+            return ops.sosfilt(c, sos, impl="xla",
+                               chunk=chunk) * jnp.float32(0.999)
+        return step
+
+    sts = chain_stats({"flat": make(0), "chunked": make(4096)}, x,
+                      iters=128, on_floor="nan", null_carry=x[:1, :8])
+    best = min(sts.values(),
+               key=lambda s: s["sec"] if s["sec"] == s["sec"] else 1e30)
+    rec = {"metric": f"sosfilt_long_b{batch}_n{n}",
+           **_msps(best, batch * n),
+           "flat_msps": _rate(sts["flat"]["sec"], batch * n),
+           "chunked_msps": _rate(sts["chunked"]["sec"], batch * n)}
+    f, c = sts["flat"]["sec"], sts["chunked"]["sec"]
+    if f == f and c == c:
+        rec["chunked_vs_flat"] = round(f / c, 3)
+    return rec
+
+
 CONFIGS = (bench_elementwise, bench_convolve, bench_convolve_batched,
            bench_dwt, bench_batched_pipeline, bench_flagship, bench_stream,
-           bench_spectral, bench_iir, bench_feed_io)
+           bench_spectral, bench_iir, bench_iir_long, bench_feed_io)
 
 
 def collect_secondary(scale=None, progress=None) -> dict:
