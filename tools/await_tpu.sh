@@ -8,8 +8,11 @@
 # The poll loop gives up after $VELES_WATCH_DEADLINE_S seconds (default
 # 90 min) and exits clean; the work phase itself is timeout-capped.
 #
-# Outputs land under /tmp (kept out of the repo):
+# Logs land under /tmp; the one repo-root artifact is TPU_EVIDENCE.md
+# (the harvest summary, written only after a successful recovery run so
+# the round records the evidence even if the operator is mid-task):
 #   /tmp/tpu_watch.log        - progress + summaries
+#   /tmp/tpu_smoke.log        - full Mosaic-validation output
 #   /tmp/tpu_suite.log        - full VELES_TEST_TPU pytest output
 #   /tmp/tune_matmul.log      - tile sweep table
 #   /tmp/bench_preview.json   - bench.py stdout (the driver-format line)
@@ -23,7 +26,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "[watch] TPU UP at $(date -u +%H:%M:%S)"
 
     echo "[watch] === tpu_smoke ==="
-    timeout 1800 python tools/tpu_smoke.py 2>&1 | tail -15
+    timeout 1800 python tools/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1
+    tail -15 /tmp/tpu_smoke.log
 
     echo "[watch] === tune_matmul sweep ==="
     timeout 2400 python tools/tune_matmul.py > /tmp/tune_matmul.log 2>&1
@@ -33,10 +37,24 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     timeout 2400 python bench.py > /tmp/bench_preview.json 2>/tmp/bench_err.log
     cat /tmp/bench_preview.json
 
+    echo "[watch] === AVX-vs-TPU speedup table ==="
+    timeout 120 python tools/speedup_table.py \
+      --bench /tmp/bench_preview.json 2>&1 | tail -12
+
     echo "[watch] === VELES_TEST_TPU suite ==="
     timeout 3600 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
       > /tmp/tpu_suite.log 2>&1
     tail -3 /tmp/tpu_suite.log
+
+    # harvest the evidence into the repo so the round records it even
+    # if the operator is mid-task when recovery lands (committed later)
+    {
+      echo "# TPU evidence harvest $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+      echo; echo "## tpu_smoke tail"; tail -20 /tmp/tpu_smoke.log 2>/dev/null
+      echo; echo "## tune_matmul tail"; tail -25 /tmp/tune_matmul.log
+      echo; echo "## bench stdout"; cat /tmp/bench_preview.json
+      echo; echo "## suite tail"; tail -5 /tmp/tpu_suite.log
+    } > TPU_EVIDENCE.md
 
     echo "[watch] DONE $(date -u +%H:%M:%S)"
     exit 0

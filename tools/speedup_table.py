@@ -35,8 +35,14 @@ def _load_bench_record(path=None):
     for cand in candidates:
         if not cand or not os.path.exists(cand):
             continue
-        with open(cand) as f:
-            rec = json.load(f)
+        try:
+            with open(cand) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # an empty/truncated record (bench killed mid-write) must
+            # not crash the table — fall through to the next candidate
+            print(f"skipping {cand}: {e}", file=sys.stderr)
+            continue
         # driver artifacts wrap the stdout line under "parsed"
         rec = rec.get("parsed", rec) or {}
         if rec.get("value") is not None or rec.get("configs"):

@@ -45,6 +45,23 @@ def main():
         want = np.asarray(ops.matrix_multiply(a, a, impl="xla"))
         np.testing.assert_allclose(got, want, atol=0.5, rtol=0.05)
 
+    def matmul_f32():
+        # the precision="highest" kernel variant keeps full-width
+        # operands through the in-kernel dot — a distinct Mosaic
+        # lowering (multi-pass f32 product) that must be validated
+        # separately from the bf16-cast kernel
+        from veles.simd_tpu import ops
+        a = jnp.asarray(rng.normal(size=(384, 260)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(260, 130)).astype(np.float32))
+        got = np.asarray(ops.matrix_multiply(a, b, impl="pallas",
+                                             precision="highest"))
+        want = np.asarray(ops.matrix_multiply(a, b, impl="xla",
+                                              precision="highest"))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-4)
+        gt = np.asarray(ops.matrix_multiply_transposed(
+            a, b.T.copy(), impl="pallas", precision="highest"))
+        np.testing.assert_allclose(gt, want, rtol=2e-5, atol=2e-4)
+
     def dwt():
         from veles.simd_tpu import ops
         x = rng.normal(size=(3, 4096)).astype(np.float32)
@@ -102,6 +119,7 @@ def main():
         np.testing.assert_allclose(got, x * 2.5, rtol=1e-6)
 
     for name, fn in [("pallas matmul (bf16 blocks)", matmul),
+                     ("pallas matmul f32 product", matmul_f32),
                      ("pallas DWT gridded+batched", dwt),
                      ("pallas DWT 4M multi-block", dwt_multiblock),
                      ("pallas SWT dilated", swt),
