@@ -206,6 +206,77 @@ class TestIirFuzz:
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+class TestLfilter:
+    @pytest.mark.parametrize("order,wn", [(2, 0.1), (4, 0.25), (6, 0.3)])
+    def test_iir_differential(self, rng, order, wn):
+        """(b, a) path vs scipy.signal.lfilter float64: the tf2sos
+        cascade must match the direct form for stable filters."""
+        from scipy.signal import butter, lfilter as sp_lfilter
+
+        b, a = butter(order, wn)
+        x = rng.normal(size=(3, 700)).astype(np.float32)
+        want = sp_lfilter(b, a, x.astype(np.float64), axis=-1)
+        got = np.asarray(ops.lfilter(b, a, x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_fir_path(self, rng):
+        """len(a)==1 runs as trimmed causal convolution."""
+        from scipy.signal import lfilter as sp_lfilter
+
+        b = rng.normal(size=17).astype(np.float64)
+        x = rng.normal(size=300).astype(np.float32)
+        want = sp_lfilter(b, [2.0], x.astype(np.float64))
+        got = np.asarray(ops.lfilter(b, [2.0], x))
+        assert got.shape == x.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_reference_impl_and_contracts(self, rng):
+        from scipy.signal import butter
+
+        b, a = butter(4, 0.2)
+        x = rng.normal(size=128).astype(np.float32)
+        ref = ops.lfilter(b, a, x, impl="reference")
+        got = np.asarray(ops.lfilter(b, a, x))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            ops.lfilter(b, [0.0], x)  # a[0] == 0
+        with pytest.raises(ValueError):
+            ops.lfilter(np.zeros((2, 2)), a, x)  # non-1-D b
+
+
+class TestDecimate:
+    @pytest.mark.parametrize("q", [2, 4, 7])
+    def test_interior_matches_scipy(self, rng, q):
+        """Interior samples match scipy.signal.decimate (zero_phase);
+        the unpadded sosfiltfilt makes the edge spans differ by
+        construction (see sosfiltfilt docstring)."""
+        from scipy.signal import decimate as sp_decimate
+
+        n = 4096
+        x = rng.normal(size=n).astype(np.float32)
+        want = sp_decimate(x.astype(np.float64), q)
+        got = np.asarray(ops.decimate(x, q))
+        assert got.shape == want.shape
+        m = len(got)
+        sl = slice(m // 8, -m // 8)  # away from both transients
+        np.testing.assert_allclose(got[sl], want[sl], rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_q1_identity_and_contracts(self, rng):
+        x = rng.normal(size=64).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(ops.decimate(x, 1)), x)
+        with pytest.raises(ValueError):
+            ops.decimate(x, 0)
+
+    def test_aliasing_suppressed(self):
+        """A tone above the post-decimation Nyquist must not fold back."""
+        n, q = 8192, 4
+        t = np.arange(n)
+        hi = np.sin(2 * np.pi * 0.35 * t).astype(np.float32)  # > 1/(2q)
+        got = np.asarray(ops.decimate(hi, q))
+        assert np.std(got[200:-200]) < 0.02
+
+
 class TestSosfreqz:
     def test_matches_scipy(self):
         sos = _sos(6, 0.25)

@@ -249,3 +249,60 @@ class TestHilbert:
         want = refs.envelope(x)
         assert got.shape == want.shape
         np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestDetrend:
+    """detrend vs scipy.signal.detrend (the definitional oracle)."""
+
+    @pytest.mark.parametrize("kind", ["constant", "linear"])
+    def test_matches_scipy(self, rng, kind):
+        from veles.simd_tpu.reference import spectral as refs
+        x = (rng.normal(size=(3, 500))
+             + 5.0 + 0.01 * np.arange(500)).astype(np.float32)
+        want = refs.detrend(x, kind)
+        got = np.asarray(ops.detrend(x, kind))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_removes_exact_line(self):
+        t = np.arange(1000, dtype=np.float32)
+        x = 3.0 + 0.25 * t
+        got = np.asarray(ops.detrend(x))
+        np.testing.assert_allclose(got, np.zeros_like(t), atol=1e-2)
+
+    def test_bad_type(self):
+        with pytest.raises(ValueError):
+            ops.detrend(np.zeros(8, np.float32), "quadratic")
+
+
+class TestCsdCoherence:
+    def test_csd_of_self_is_welch(self, rng):
+        x = rng.normal(size=4096).astype(np.float32)
+        pxx = np.asarray(ops.welch(x, nfft=256))
+        pxy = np.asarray(ops.csd(x, x, nfft=256))
+        np.testing.assert_allclose(pxy.imag, 0.0, atol=1e-8)
+        np.testing.assert_allclose(pxy.real, pxx, rtol=1e-4, atol=1e-8)
+
+    def test_matches_oracle(self, rng):
+        from veles.simd_tpu.reference import spectral as refs
+        x = rng.normal(size=(2, 4096)).astype(np.float32)
+        y = rng.normal(size=(2, 4096)).astype(np.float32)
+        got = np.asarray(ops.csd(x, y, nfft=256))
+        want = refs.csd(x, y, nfft=256)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        gotc = np.asarray(ops.coherence(x, y, nfft=256))
+        wantc = refs.coherence(x, y, nfft=256)
+        np.testing.assert_allclose(gotc, wantc, atol=1e-4)
+
+    def test_coherence_detects_linear_coupling(self, rng):
+        """y = filtered x + noise: coherence ~1 in the passband where
+        the filtered copy dominates, ~0 for independent noise."""
+        n = 1 << 15
+        x = rng.normal(size=n).astype(np.float32)
+        y_dep = np.asarray(ops.sosfilt(x, ops.butter_sos(4, 0.5)))
+        y_ind = rng.normal(size=n).astype(np.float32)
+        coh_dep = np.asarray(ops.coherence(x, y_dep, nfft=256))
+        coh_ind = np.asarray(ops.coherence(x, y_ind, nfft=256))
+        lo_band = slice(2, 40)  # deep passband of the 0.5-cutoff filter
+        assert coh_dep[lo_band].min() > 0.95
+        assert coh_ind.mean() < 0.2
+        assert coh_dep.max() <= 1.0 + 1e-5

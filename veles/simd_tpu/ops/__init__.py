@@ -42,13 +42,13 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate_initialize, cross_correlate_overlap_save,
     cross_correlate_simd)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
-    IirStreamState, butter_sos, iir_stream_init, iir_stream_step, sosfilt,
-    sosfiltfilt, sosfreqz)
+    IirStreamState, butter_sos, cheby1_sos, decimate, iir_stream_init,
+    iir_stream_step, lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
-    envelope, frame, hann_window, hilbert, istft, overlap_add,
-    spectrogram, stft, welch)
+    coherence, csd, detrend, envelope, frame, hann_window, hilbert, istft,
+    overlap_add, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,

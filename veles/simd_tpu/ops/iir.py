@@ -198,6 +198,90 @@ def butter_sos(order, wn, btype="lowpass"):
     return butter(order, wn, btype=btype, output="sos")
 
 
+def cheby1_sos(order, rp, wn, btype="lowpass"):
+    """Chebyshev type-I design (host-side, float64 scipy): passband
+    ripple ``rp`` dB, normalized cutoff ``wn``; returns (n_sections, 6).
+    The filter :func:`decimate` uses by default (scipy's choice)."""
+    from scipy.signal import cheby1
+
+    return cheby1(order, rp, wn, btype=btype, output="sos")
+
+
+def tf2sos(b, a):
+    """Transfer-function -> cascaded-biquad conversion (host-side,
+    float64 scipy): the bridge from ``(b, a)`` coefficient APIs to this
+    module's sos convention; returns (n_sections, 6)."""
+    from scipy.signal import tf2sos as _tf2sos
+
+    return _tf2sos(np.asarray(b, np.float64), np.asarray(a, np.float64))
+
+
+def lfilter(b, a, x, *, impl=None, chunk=None):
+    """scipy.signal.lfilter semantics over the last axis (zero initial
+    state); leading axes of ``x`` are batch.
+
+    A pure-FIR filter (``len(a) == 1``) runs as a trimmed causal
+    convolution; anything recursive converts to a biquad cascade
+    host-side (:func:`tf2sos`, float64) and runs :func:`sosfilt` — the
+    cascade is the TPU-native factorization, and for stable filters it
+    matches the direct form to float32 tolerance (the direct transposed
+    form scipy iterates sample-by-sample has no parallel-scan analogue
+    at order > 2 without the companion-matrix blow-up).
+    """
+    b = np.atleast_1d(np.asarray(b, np.float64))
+    a = np.atleast_1d(np.asarray(a, np.float64))
+    if b.ndim != 1 or a.ndim != 1 or a.size == 0 or a[0] == 0:
+        raise ValueError("b and a must be 1-D with a[0] != 0")
+    impl = resolve_impl(impl)
+    if impl == "reference":  # before any jnp touch: the reference leg
+        from scipy.signal import lfilter as _lfilter  # must work with
+        return _lfilter(b, a, np.asarray(x, np.float64),  # no backend
+                        axis=-1)
+    if a.size == 1:
+        from veles.simd_tpu.ops.convolve import convolve
+
+        h = (b / a[0]).astype(np.float32)
+        x = jnp.asarray(x)
+        return convolve(x, h, impl=impl)[..., :x.shape[-1]]
+    return sosfilt(x, tf2sos(b, a), impl=impl, chunk=chunk)
+
+
+def decimate(x, q, *, order=8, rp=0.05, zero_phase=True, impl=None):
+    """Downsample by integer ``q`` after anti-alias IIR filtering —
+    scipy.signal.decimate's default path (order-8 Chebyshev type I,
+    0.05 dB ripple, cutoff 0.8/q), data axis last.
+
+    ``zero_phase=True`` runs :func:`sosfiltfilt`, which here pads
+    nothing (see its docstring): interior samples match scipy, the
+    first/last transient spans differ. For FIR anti-aliasing use
+    ``ops.resample_poly(x, 1, q)`` — that is scipy's ftype="fir" path
+    with a polyphase schedule that never computes the discarded
+    samples.
+    """
+    q = int(q)
+    if q < 1:
+        raise ValueError("q must be >= 1")
+    impl = resolve_impl(impl)
+    if impl == "reference":  # before any jnp touch (backend-free leg)
+        if rp != 0.05:
+            raise ValueError(
+                "impl='reference' delegates to scipy.signal.decimate, "
+                "which hardcodes 0.05 dB ripple; rp is only honored on "
+                "the device path")
+        from scipy.signal import decimate as _decimate
+        x64 = np.asarray(x, np.float64)
+        if q == 1:
+            return x64
+        return _decimate(x64, q, n=order, zero_phase=zero_phase, axis=-1)
+    x = jnp.asarray(x, jnp.float32)
+    if q == 1:
+        return x
+    sos = cheby1_sos(order, rp, 0.8 / q)
+    y = (sosfiltfilt(x, sos, impl=impl) if zero_phase
+         else sosfilt(x, sos, impl=impl))
+    return y[..., ::q]
+
+
 def _sosfreqz_f64(sos64, n_freqs):
     # host-side float64 evaluation (numpy complex128): a high-order
     # cascade's stopband sits tens of dB down, where a complex64

@@ -177,6 +177,76 @@ def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
             (jnp.sum(w * w) * nfft)).astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("kind",))
+def _detrend_xla(x, kind):
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    if kind == "constant" or n < 2:
+        # n == 1: the "line" is the point itself; scipy returns zeros,
+        # which the constant branch reproduces (a bare slope formula
+        # would divide by sum(tc^2) == 0)
+        return x - jnp.mean(x, axis=-1, keepdims=True)
+    # closed-form least-squares line per row: centering t makes the
+    # normal equations diagonal, so slope = <x, t-c> / <(t-c)^2>
+    t = jnp.arange(n, dtype=jnp.float32)
+    tc = t - (n - 1) / 2.0
+    slope = (jnp.sum(x * tc, axis=-1, keepdims=True)
+             / jnp.sum(tc * tc))
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    return x - mean - slope * tc
+
+
+def detrend(x, type="linear", *, impl=None):
+    """Remove a per-row constant or least-squares line over the last
+    axis (scipy.signal.detrend semantics, ``type`` in {"linear",
+    "constant"}); leading axes are batch. The usual pre-pass before
+    spectral estimation on drifting sensor data."""
+    if type not in ("linear", "constant"):
+        raise ValueError(f"type must be 'linear' or 'constant', "
+                         f"got {type!r}")
+    if resolve_impl(impl) == "reference":
+        return _ref.detrend(x, type)
+    return _detrend_xla(x, type)
+
+
+def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None,
+        impl=None):
+    """Cross-spectral density -> complex64 (..., nfft//2+1): Welch's
+    averaging applied to ``conj(STFT(x)) * STFT(y)``, same framing and
+    window-energy normalization as :func:`welch` (``csd(x, x)`` IS
+    ``welch(x)``). No per-segment detrending — scipy's
+    ``detrend="constant"`` default differs on signals with DC/drift;
+    run :func:`detrend` first for that behavior."""
+    if resolve_impl(impl) == "reference":
+        return _ref.csd(x, y, nfft=nfft, hop=hop, window=window)
+    hop = nfft // 4 if hop is None else hop
+    w = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    sx = stft(x, nfft=nfft, hop=hop, window=w, impl="xla")
+    sy = stft(y, nfft=nfft, hop=hop, window=w, impl="xla")
+    return (jnp.mean(jnp.conj(sx) * sy, axis=-2)
+            / (jnp.sum(w * w) * nfft))
+
+
+def coherence(x, y, *, nfft: int = 512, hop: int | None = None,
+              window=None, impl=None):
+    """Magnitude-squared coherence -> float32 (..., nfft//2+1) in
+    [0, 1]: |Pxy|^2 / (Pxx * Pyy) over the shared Welch framing — the
+    frequency-resolved correlation detector (which bands of ``y`` are
+    linearly driven by ``x``)."""
+    if resolve_impl(impl) == "reference":
+        return _ref.coherence(x, y, nfft=nfft, hop=hop, window=window)
+    hop = nfft // 4 if hop is None else hop
+    w = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    sx = stft(x, nfft=nfft, hop=hop, window=w, impl="xla")
+    sy = stft(y, nfft=nfft, hop=hop, window=w, impl="xla")
+    pxy = jnp.mean(jnp.conj(sx) * sy, axis=-2)
+    pxx = jnp.mean(jnp.abs(sx) ** 2, axis=-2)
+    pyy = jnp.mean(jnp.abs(sy) ** 2, axis=-2)
+    return (jnp.abs(pxy) ** 2 / (pxx * pyy + 1e-30)).astype(jnp.float32)
+
+
 @jax.jit
 def _hilbert_xla(x):
     x = jnp.asarray(x, jnp.float32)

@@ -88,6 +88,33 @@ def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
     return p.mean(axis=-2) / (np.sum(w * w) * nfft)
 
 
+def detrend(x, type="linear"):
+    """scipy.signal.detrend itself (float64) — the definitional oracle."""
+    from scipy.signal import detrend as _detrend
+
+    return _detrend(np.asarray(x, np.float64), axis=-1, type=type)
+
+
+def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None):
+    hop = nfft // 4 if hop is None else hop
+    w = _window(nfft, window)
+    sx = stft(x, nfft=nfft, hop=hop, window=w)
+    sy = stft(y, nfft=nfft, hop=hop, window=w)
+    return (np.conj(sx) * sy).mean(axis=-2) / (np.sum(w * w) * nfft)
+
+
+def coherence(x, y, *, nfft: int = 512, hop: int | None = None,
+              window=None):
+    hop = nfft // 4 if hop is None else hop
+    w = _window(nfft, window)
+    sx = stft(x, nfft=nfft, hop=hop, window=w)
+    sy = stft(y, nfft=nfft, hop=hop, window=w)
+    pxy = (np.conj(sx) * sy).mean(axis=-2)
+    pxx = (np.abs(sx) ** 2).mean(axis=-2)
+    pyy = (np.abs(sy) ** 2).mean(axis=-2)
+    return np.abs(pxy) ** 2 / (pxx * pyy)
+
+
 def hilbert(x):
     """Analytic signal oracle (scipy.signal.hilbert, float64 -> complex)."""
     from scipy.signal import hilbert as _hilbert
