@@ -135,6 +135,7 @@ def worker_main(headline_only: bool, progress_path: str | None) -> int:
     progress = open(progress_path, "a") if progress_path else None
     result = bench_matmul_4096()
     result["backend"] = backend
+    _annotate_ref_avx(result)
     if progress:
         print(json.dumps({"__headline__": result}), file=progress,
               flush=True)
@@ -142,8 +143,45 @@ def worker_main(headline_only: bool, progress_path: str | None) -> int:
         from veles.simd_tpu.utils.bench_extra import collect_secondary
         result["configs"] = collect_secondary(
             progress=_Tee(sys.stderr, progress))
+        for metric, cfg in result["configs"].items():
+            _annotate_ref_avx(cfg, metric)
     print(json.dumps(result))
     return 0
+
+
+_REF_BASELINE_CACHE: list = []  # one-element memo: [configs-or-None]
+
+
+def _load_ref_baseline():
+    if not _REF_BASELINE_CACHE:
+        try:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "REF_BASELINE.json")
+            with open(path) as f:
+                _REF_BASELINE_CACHE.append(json.load(f)["configs"])
+        except (OSError, ValueError, KeyError):
+            _REF_BASELINE_CACHE.append(None)
+    return _REF_BASELINE_CACHE[0]
+
+
+def _annotate_ref_avx(rec: dict, metric: str | None = None) -> None:
+    """Attach the measured reference-AVX baseline ratio in place.
+
+    REF_BASELINE.json (tools/ref_baseline.sh: the reference library
+    built -O3 -march=native, timed at these exact shapes) shares metric
+    names with the bench configs by construction; when a row matches,
+    the record carries ``ref_avx`` (the baseline value) and
+    ``vs_ref_avx`` (TPU / AVX — the honest speedup column) directly,
+    so the driver artifact is self-contained evidence."""
+    ref = _load_ref_baseline()
+    if ref is None:
+        return
+    cfg = ref.get(metric or rec.get("metric", ""))
+    value = rec.get("value")
+    if not cfg or not isinstance(value, (int, float)) or not cfg.get("value"):
+        return
+    rec["ref_avx"] = cfg["value"]
+    rec["vs_ref_avx"] = round(value / cfg["value"], 1)
 
 
 def _parse_worker_json(stdout: str):

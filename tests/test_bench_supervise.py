@@ -157,3 +157,19 @@ def test_attempt_spread_fields_cpu_smoke():
     assert all(isinstance(s, float) for s in st["attempt_sec"])
     finite = [s for s in st["attempt_sec"] if s == s]
     assert all(s > 0 for s in finite)
+
+
+def test_ref_avx_annotation():
+    """Bench records self-annotate with the measured AVX baseline ratio
+    when metric names match REF_BASELINE.json; non-matching or null
+    records stay untouched."""
+    rec = {"metric": "matrix_multiply_f32_n4096", "value": 110.4}
+    bench._annotate_ref_avx(rec)
+    assert rec["ref_avx"] > 0
+    assert rec["vs_ref_avx"] == round(110.4 / rec["ref_avx"], 1)
+    null_rec = {"value": None}
+    bench._annotate_ref_avx(null_rec, "convolve_n65536_m127")
+    assert "vs_ref_avx" not in null_rec
+    missing = {"value": 5.0}
+    bench._annotate_ref_avx(missing, "no_such_metric")
+    assert "vs_ref_avx" not in missing

@@ -1,0 +1,130 @@
+"""Smoothing ops (ops/smooth.py) vs the scipy float64 oracle."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+from veles.simd_tpu.reference import smooth as ref_smooth
+
+
+class TestMedfilt:
+    @pytest.mark.parametrize("k", [1, 3, 5, 9])
+    def test_differential(self, rng, k):
+        x = rng.normal(size=200).astype(np.float32)
+        want = ref_smooth.medfilt(x, k)
+        got = np.asarray(ops.medfilt(x, k))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(2, 3, 150)).astype(np.float32)
+        want = ref_smooth.medfilt(x, 5)
+        got = np.asarray(ops.medfilt(x, 5))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_impulse_rejection(self):
+        """The defining property: isolated spikes vanish entirely,
+        which no linear filter achieves."""
+        x = np.zeros(100, np.float32)
+        x[30] = 100.0
+        got = np.asarray(ops.medfilt(x, 3))
+        np.testing.assert_array_equal(got, np.zeros_like(x))
+
+    def test_contracts(self):
+        with pytest.raises(ValueError):
+            ops.medfilt(np.zeros(8, np.float32), 4)  # even kernel
+        with pytest.raises(ValueError):
+            ops.medfilt(np.zeros(8, np.float32), 0)
+
+
+class TestSavgol:
+    @pytest.mark.parametrize("wl,po", [(5, 2), (11, 3), (21, 4)])
+    @pytest.mark.parametrize("mode", ["mirror", "nearest", "wrap",
+                                      "constant"])
+    def test_differential(self, rng, wl, po, mode):
+        x = rng.normal(size=300).astype(np.float32)
+        want = ref_smooth.savgol_filter(x, wl, po, mode=mode)
+        got = np.asarray(ops.savgol_filter(x, wl, po, mode=mode))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_derivative(self, rng):
+        """deriv=1 on a clean quadratic returns its exact derivative
+        (interior; a degree-2 fit reproduces a quadratic exactly)."""
+        t = np.arange(200, dtype=np.float64)
+        x = (0.01 * t * t).astype(np.float32)
+        got = np.asarray(ops.savgol_filter(x, 11, 2, deriv=1))
+        want = 0.02 * t
+        np.testing.assert_allclose(got[10:-10], want[10:-10], atol=1e-3)
+
+    def test_polynomial_passthrough(self, rng):
+        """A polynomial of degree <= polyorder passes unchanged in the
+        interior — the filter's defining invariant."""
+        t = np.linspace(-1, 1, 400)
+        x = (2.0 + 3.0 * t - 1.5 * t ** 2 + 0.5 * t ** 3).astype(
+            np.float32)
+        got = np.asarray(ops.savgol_filter(x, 15, 3))
+        np.testing.assert_allclose(got[20:-20], x[20:-20], atol=1e-4)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(4, 128)).astype(np.float32)
+        want = ref_smooth.savgol_filter(x, 9, 2)
+        got = np.asarray(ops.savgol_filter(x, 9, 2))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_contracts(self):
+        x = np.zeros(32, np.float32)
+        with pytest.raises(ValueError):
+            ops.savgol_filter(x, 8, 2)          # even window
+        with pytest.raises(ValueError):
+            ops.savgol_filter(x, 5, 5)          # polyorder >= window
+        with pytest.raises(ValueError):
+            ops.savgol_filter(x, 5, 2, mode="interp")  # not offered
+
+
+def test_firwin_passthrough():
+    from scipy.signal import firwin as sp_firwin
+
+    h = ops.firwin(31, 0.3)
+    np.testing.assert_array_equal(h, sp_firwin(31, 0.3))
+
+
+class TestWelchDetrend:
+    def test_constant_detrend_kills_dc(self, rng):
+        """A large DC offset dominates bin 0 without detrending and
+        vanishes with detrend='constant' — scipy.welch's default
+        behavior, now reproducible here."""
+        x = (rng.normal(size=8192) + 100.0).astype(np.float32)
+        p_raw = np.asarray(ops.welch(x, nfft=256))
+        p_dt = np.asarray(ops.welch(x, nfft=256, detrend="constant"))
+        assert p_raw[0] > 1e3 * p_dt[0]
+
+    @pytest.mark.parametrize("kind", ["constant", "linear"])
+    def test_matches_oracle(self, rng, kind):
+        from veles.simd_tpu.reference import spectral as refs
+
+        x = (rng.normal(size=(2, 4096))
+             + 0.01 * np.arange(4096)).astype(np.float32)
+        y = rng.normal(size=(2, 4096)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ops.welch(x, nfft=256, detrend=kind)),
+            refs.welch(x, nfft=256, detrend=kind), rtol=1e-3, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(ops.csd(x, y, nfft=256, detrend=kind)),
+            refs.csd(x, y, nfft=256, detrend=kind), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ops.coherence(x, y, nfft=256, detrend=kind)),
+            refs.coherence(x, y, nfft=256, detrend=kind), atol=1e-4)
+
+    def test_detrend_spellings(self, rng):
+        """detrend=False (scipy's disable spelling) is a no-op; unknown
+        kinds raise on both backends instead of silently going linear."""
+        x = rng.normal(size=2048).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(ops.welch(x, nfft=256, detrend=False)),
+            np.asarray(ops.welch(x, nfft=256)))
+        with pytest.raises(ValueError, match="detrend"):
+            ops.welch(x, nfft=256, detrend="lin")
+        with pytest.raises(ValueError, match="detrend"):
+            ops.csd(x, x, nfft=256, detrend="lin", impl="reference")
+        with pytest.raises(ValueError, match="window length"):
+            ops.welch(x, nfft=256, window=np.hanning(128))

@@ -45,7 +45,9 @@ from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, butter_sos, cheby1_sos, decimate, iir_stream_init,
     iir_stream_step, lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
-    resample_filter, resample_poly, upfirdn)
+    firwin, resample_filter, resample_poly, upfirdn)
+from veles.simd_tpu.ops.smooth import (  # noqa: F401
+    medfilt, savgol_coeffs, savgol_filter)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     coherence, csd, detrend, envelope, frame, hann_window, hilbert, istft,
     overlap_add, spectrogram, stft, welch)

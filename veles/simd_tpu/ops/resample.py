@@ -89,6 +89,16 @@ def upfirdn(x, h, up=1, down=1, *, impl=None):
     return _upfirdn_xla(x, h, int(up), int(down), h.shape[-1])
 
 
+def firwin(numtaps, cutoff, *, window="hamming", pass_zero=True):
+    """Window-method FIR design (host-side, float64 scipy passthrough):
+    the general-purpose companion of :func:`resample_filter` for callers
+    bringing their own band edges; feed the taps to ``ops.convolve`` /
+    ``ops.lfilter`` / ``ops.upfirdn``."""
+    from scipy.signal import firwin as _firwin
+
+    return _firwin(numtaps, cutoff, window=window, pass_zero=pass_zero)
+
+
 def resample_filter(up, down, taps_per_phase=16, beta=8.0):
     """Kaiser-windowed lowpass for resample_poly (host-side design,
     float64): cutoff at the tighter of the two Nyquists, unity passband

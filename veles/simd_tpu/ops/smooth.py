@@ -1,0 +1,111 @@
+"""Smoothing filters: sliding median and Savitzky-Golay.
+
+Framework extensions along the scipy.signal axis (the reference C
+library has no smoother family). Both reduce to TPU-friendly
+primitives:
+
+* ``medfilt`` — the gather-free framing view (``frame`` with hop 1)
+  turns the sliding window into a (..., n, k) tensor; the median is one
+  ``jnp.median`` over the trailing axis. Sorting k lanes per output
+  sample is the honest formulation on a vector unit — there is no
+  shift-add shortcut for order statistics.
+* ``savgol_filter`` — the polynomial fit is linear in the samples, so
+  the whole filter is one FIR correlation with host-designed
+  coefficients (scipy.signal.savgol_coeffs, float64) plus an edge
+  policy expressed as ``jnp.pad`` modes.
+
+Oracle: reference/smooth.py (scipy float64), tests/test_smooth.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.ops.spectral import frame
+from veles.simd_tpu.reference import smooth as _ref
+
+_PAD_MODES = {"mirror": "reflect", "nearest": "edge", "wrap": "wrap",
+              "constant": "constant"}
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_size",))
+def _medfilt_xla(x, kernel_size):
+    k = kernel_size
+    pad = [(0, 0)] * (x.ndim - 1) + [(k // 2, k // 2)]
+    xp = jnp.pad(x, pad)  # zero padding — scipy.signal.medfilt's policy
+    return jnp.median(frame(xp, k, 1), axis=-1)
+
+
+def medfilt(x, kernel_size=3, *, impl=None):
+    """Sliding-window median over the last axis (scipy.signal.medfilt
+    semantics: odd ``kernel_size``, zero-padded edges, same length);
+    leading axes are batch. The classic impulse-noise rejector that no
+    linear filter reproduces."""
+    kernel_size = int(kernel_size)
+    if kernel_size < 1 or kernel_size % 2 == 0:
+        raise ValueError(f"kernel_size must be odd and >= 1, "
+                         f"got {kernel_size}")
+    if resolve_impl(impl) == "reference":
+        return _ref.medfilt(x, kernel_size)
+    x = jnp.asarray(x, jnp.float32)
+    if kernel_size == 1:
+        return x
+    if x.shape[-1] < 1:
+        return x
+    return _medfilt_xla(x, kernel_size)
+
+
+def savgol_coeffs(window_length, polyorder, deriv=0, delta=1.0):
+    """Savitzky-Golay FIR taps (host-side, float64 scipy)."""
+    from scipy.signal import savgol_coeffs as _coeffs
+
+    return _coeffs(window_length, polyorder, deriv=deriv, delta=delta)
+
+
+def savgol_filter(x, window_length, polyorder, *, deriv=0, delta=1.0,
+                  mode="mirror", impl=None):
+    """Savitzky-Golay smoothing/differentiation over the last axis:
+    least-squares polynomial fit per window, evaluated (or
+    differentiated ``deriv`` times) at the center — one FIR correlation
+    with host-designed taps.
+
+    ``mode`` maps to a pad policy in {"mirror", "nearest", "wrap",
+    "constant"} (scipy spellings). scipy's default ``mode="interp"``
+    (edge polynomial refit) is intentionally not offered: it is a
+    per-edge least-squares solve, host logic rather than a kernel —
+    use ``mode="mirror"`` (the default here) for near-identical
+    interior behavior; edges then follow the reflect policy on both
+    sides (oracle-matched, scipy supports the same mode).
+    """
+    window_length = int(window_length)
+    if window_length < 1 or window_length % 2 == 0:
+        raise ValueError(f"window_length must be odd and >= 1, "
+                         f"got {window_length}")
+    if polyorder >= window_length:
+        raise ValueError("polyorder must be < window_length")
+    if mode not in _PAD_MODES:
+        raise ValueError(f"mode must be one of {sorted(_PAD_MODES)}, "
+                         f"got {mode!r}")
+    if resolve_impl(impl) == "reference":
+        return _ref.savgol_filter(x, window_length, polyorder,
+                                  deriv=deriv, delta=delta, mode=mode)
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(savgol_coeffs(window_length, polyorder, deriv=deriv,
+                                  delta=delta), jnp.float32)
+    return _savgol_xla(x, h, _PAD_MODES[mode])
+
+
+@functools.partial(jax.jit, static_argnames=("pad_mode",))
+def _savgol_xla(x, h, pad_mode):
+    k = h.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 1) + [(k // 2, k // 2)]
+    xp = jnp.pad(x, pad, mode=pad_mode)
+    # correlation (no tap reversal): savgol_coeffs are emitted in
+    # convolution order, so flip for the correlation view — matches
+    # scipy.signal.savgol_filter's use of convolve1d
+    win = frame(xp, k, 1)  # (..., n, k)
+    return jnp.einsum("...nk,k->...n", win, h[::-1])

@@ -161,19 +161,49 @@ def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None,
     return (jnp.abs(s) ** 2).astype(jnp.float32)
 
 
+def _psd_detrend_kind(detrend):
+    """Validate the estimators' ``detrend`` argument: None/False (scipy's
+    disable spelling) mean no-op; 'constant'/'linear' are kinds;
+    anything else is an error, never a silent default."""
+    if detrend is None or detrend is False:
+        return None
+    if detrend in ("constant", "linear"):
+        return detrend
+    raise ValueError(f"detrend must be None, False, 'constant' or "
+                     f"'linear', got {detrend!r}")
+
+
+def _psd_stft(x, w, nfft, hop, detrend_kind):
+    """Framing for the PSD estimators: optional per-segment detrend
+    (scipy.signal.welch's ``detrend`` semantics) before windowing."""
+    if w.shape[-1] != nfft:
+        raise ValueError(f"window length {w.shape[-1]} != nfft {nfft}")
+    fr = frame(jnp.asarray(x, jnp.float32), nfft, hop)
+    if detrend_kind is not None:
+        fr = _detrend_xla(fr, detrend_kind)
+    return jnp.fft.rfft(fr * w, axis=-1)
+
+
 def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
-          impl=None):
+          detrend=None, impl=None):
     """Welch power spectral density -> float32 (..., nfft//2+1): the
     spectrogram averaged over frames, normalized by the window energy
     (``sum(w^2) * nfft``) — the estimator models.SpectralPeakAnalyzer
-    feeds its peak extraction."""
+    feeds its peak extraction.
+
+    ``detrend`` in {None, "constant", "linear"} applies scipy.welch's
+    per-segment detrending before windowing (scipy defaults to
+    "constant"; this library defaults to None — no silent mutation of
+    the segments)."""
+    detrend = _psd_detrend_kind(detrend)
     if resolve_impl(impl) == "reference":
-        return _ref.welch(x, nfft=nfft, hop=hop, window=window)
+        return _ref.welch(x, nfft=nfft, hop=hop, window=window,
+                          detrend=detrend)
     hop = nfft // 4 if hop is None else hop
     w = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
-    p = spectrogram(x, nfft=nfft, hop=hop, window=w, impl="xla")
-    return (jnp.mean(p, axis=-2) /
+    s = _psd_stft(x, w, nfft, hop, detrend)
+    return (jnp.mean(jnp.abs(s) ** 2, axis=-2) /
             (jnp.sum(w * w) * nfft)).astype(jnp.float32)
 
 
@@ -210,37 +240,40 @@ def detrend(x, type="linear", *, impl=None):
 
 
 def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None,
-        impl=None):
+        detrend=None, impl=None):
     """Cross-spectral density -> complex64 (..., nfft//2+1): Welch's
     averaging applied to ``conj(STFT(x)) * STFT(y)``, same framing and
     window-energy normalization as :func:`welch` (``csd(x, x)`` IS
-    ``welch(x)``). No per-segment detrending — scipy's
-    ``detrend="constant"`` default differs on signals with DC/drift;
-    run :func:`detrend` first for that behavior."""
+    ``welch(x)``). ``detrend`` as in :func:`welch` (None by default;
+    scipy defaults to "constant")."""
+    detrend = _psd_detrend_kind(detrend)
     if resolve_impl(impl) == "reference":
-        return _ref.csd(x, y, nfft=nfft, hop=hop, window=window)
+        return _ref.csd(x, y, nfft=nfft, hop=hop, window=window,
+                        detrend=detrend)
     hop = nfft // 4 if hop is None else hop
     w = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
-    sx = stft(x, nfft=nfft, hop=hop, window=w, impl="xla")
-    sy = stft(y, nfft=nfft, hop=hop, window=w, impl="xla")
+    sx = _psd_stft(x, w, nfft, hop, detrend)
+    sy = _psd_stft(y, w, nfft, hop, detrend)
     return (jnp.mean(jnp.conj(sx) * sy, axis=-2)
             / (jnp.sum(w * w) * nfft))
 
 
 def coherence(x, y, *, nfft: int = 512, hop: int | None = None,
-              window=None, impl=None):
+              window=None, detrend=None, impl=None):
     """Magnitude-squared coherence -> float32 (..., nfft//2+1) in
     [0, 1]: |Pxy|^2 / (Pxx * Pyy) over the shared Welch framing — the
     frequency-resolved correlation detector (which bands of ``y`` are
-    linearly driven by ``x``)."""
+    linearly driven by ``x``). ``detrend`` as in :func:`welch`."""
+    detrend = _psd_detrend_kind(detrend)
     if resolve_impl(impl) == "reference":
-        return _ref.coherence(x, y, nfft=nfft, hop=hop, window=window)
+        return _ref.coherence(x, y, nfft=nfft, hop=hop, window=window,
+                              detrend=detrend)
     hop = nfft // 4 if hop is None else hop
     w = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
-    sx = stft(x, nfft=nfft, hop=hop, window=w, impl="xla")
-    sy = stft(y, nfft=nfft, hop=hop, window=w, impl="xla")
+    sx = _psd_stft(x, w, nfft, hop, detrend)
+    sy = _psd_stft(y, w, nfft, hop, detrend)
     pxy = jnp.mean(jnp.conj(sx) * sy, axis=-2)
     pxx = jnp.mean(jnp.abs(sx) ** 2, axis=-2)
     pyy = jnp.mean(jnp.abs(sy) ** 2, axis=-2)

@@ -1,0 +1,28 @@
+"""Float64 oracle for the smoothing ops (ops/smooth.py): scipy itself.
+
+scipy.signal.medfilt / savgol_filter are the definitional semantics;
+the TPU path is differentially tested against these in
+tests/test_smooth.py (framework extension — the reference C library has
+no median or Savitzky-Golay smoother).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def medfilt(x, kernel_size):
+    from scipy.signal import medfilt as _medfilt
+
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1, x.shape[-1])
+    out = np.stack([_medfilt(r, kernel_size) for r in flat])
+    return out.reshape(x.shape)
+
+
+def savgol_filter(x, window_length, polyorder, deriv=0, delta=1.0,
+                  mode="mirror"):
+    from scipy.signal import savgol_filter as _savgol
+
+    return _savgol(np.asarray(x, np.float64), window_length, polyorder,
+                   deriv=deriv, delta=delta, axis=-1, mode=mode)

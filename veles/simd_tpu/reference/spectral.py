@@ -81,11 +81,20 @@ def spectrogram(x, *, nfft: int = 512, hop: int | None = None,
     return np.abs(stft(x, nfft=nfft, hop=hop, window=window)) ** 2
 
 
-def welch(x, *, nfft: int = 512, hop: int | None = None, window=None):
+def _psd_frames(x, w, nfft, hop, detrend_kind):
+    fr = frame(x, nfft, hop)
+    if detrend_kind is not None:
+        from scipy.signal import detrend as _detrend
+        fr = _detrend(fr, axis=-1, type=detrend_kind)
+    return np.fft.rfft(fr * w, axis=-1)
+
+
+def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
+          detrend=None):
     hop = nfft // 4 if hop is None else hop
     w = _window(nfft, window)
-    p = spectrogram(x, nfft=nfft, hop=hop, window=w)
-    return p.mean(axis=-2) / (np.sum(w * w) * nfft)
+    s = _psd_frames(x, w, nfft, hop, detrend)
+    return (np.abs(s) ** 2).mean(axis=-2) / (np.sum(w * w) * nfft)
 
 
 def detrend(x, type="linear"):
@@ -95,20 +104,21 @@ def detrend(x, type="linear"):
     return _detrend(np.asarray(x, np.float64), axis=-1, type=type)
 
 
-def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None):
+def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None,
+        detrend=None):
     hop = nfft // 4 if hop is None else hop
     w = _window(nfft, window)
-    sx = stft(x, nfft=nfft, hop=hop, window=w)
-    sy = stft(y, nfft=nfft, hop=hop, window=w)
+    sx = _psd_frames(x, w, nfft, hop, detrend)
+    sy = _psd_frames(y, w, nfft, hop, detrend)
     return (np.conj(sx) * sy).mean(axis=-2) / (np.sum(w * w) * nfft)
 
 
 def coherence(x, y, *, nfft: int = 512, hop: int | None = None,
-              window=None):
+              window=None, detrend=None):
     hop = nfft // 4 if hop is None else hop
     w = _window(nfft, window)
-    sx = stft(x, nfft=nfft, hop=hop, window=w)
-    sy = stft(y, nfft=nfft, hop=hop, window=w)
+    sx = _psd_frames(x, w, nfft, hop, detrend)
+    sy = _psd_frames(y, w, nfft, hop, detrend)
     pxy = (np.conj(sx) * sy).mean(axis=-2)
     pxx = (np.abs(sx) ** 2).mean(axis=-2)
     pyy = (np.abs(sy) ** 2).mean(axis=-2)
