@@ -111,3 +111,33 @@ def test_autocorrelation_peaks_at_zero_lag(rng):
     assert r.shape == (511,)
     assert np.argmax(r) == 255  # zero lag sits at index x_len-1
     np.testing.assert_allclose(r[255], float(np.dot(x, x)), rtol=1e-4)
+
+
+class TestCrossCorrelate2D:
+    def test_matches_scipy(self, rng):
+        from scipy.signal import correlate2d
+
+        x = rng.normal(size=(9, 12)).astype(np.float32)
+        h = rng.normal(size=(3, 4)).astype(np.float32)
+        want = correlate2d(x.astype(np.float64), h.astype(np.float64))
+        got = np.asarray(ops.cross_correlate2D(x, h))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batched_and_fft_leg(self, rng):
+        from scipy.signal import correlate2d
+
+        x = rng.normal(size=(2, 16, 16)).astype(np.float32)
+        h = rng.normal(size=(5, 5)).astype(np.float32)
+        want = np.stack([correlate2d(r.astype(np.float64),
+                                     h.astype(np.float64)) for r in x])
+        got = np.asarray(ops.cross_correlate2D(x, h, algorithm="fft"))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_autocorrelation_peak_at_center(self, rng):
+        """The matched-filter property: cross-correlating a patch with
+        itself peaks where they align."""
+        h = rng.normal(size=(7, 7)).astype(np.float32)
+        got = np.asarray(ops.cross_correlate2D(h, h))
+        peak = np.unravel_index(np.argmax(got), got.shape)
+        assert peak == (6, 6)

@@ -172,3 +172,36 @@ def test_stream_step_rejects_bad_factors():
     with pytest.raises(ValueError, match=">= 1"):
         ops.resample_stream_step(st, np.zeros(8, np.float32), h,
                                  up=2, down=0)
+
+
+class TestFourierResample:
+    """ops.resample (FFT method) vs scipy.signal.resample."""
+
+    @pytest.mark.parametrize("n,num", [(100, 50), (100, 37), (100, 200),
+                                       (128, 128), (99, 66), (64, 129)])
+    def test_differential(self, rng, n, num):
+        x = rng.normal(size=n).astype(np.float32)
+        want = ops.resample(x, num, impl="reference")
+        got = np.asarray(ops.resample(x, num))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(2, 3, 80)).astype(np.float32)
+        want = ops.resample(x, 120, impl="reference")
+        got = np.asarray(ops.resample(x, 120))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_tone_survives(self):
+        """A pure in-band tone resamples to the same tone at the new
+        rate (the periodic-extension method's exactness case)."""
+        n, num = 256, 384
+        t = np.arange(n)
+        x = np.sin(2 * np.pi * 10 * t / n).astype(np.float32)
+        got = np.asarray(ops.resample(x, num))
+        want = np.sin(2 * np.pi * 10 * np.arange(num) / num)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_contracts(self, rng):
+        with pytest.raises(ValueError):
+            ops.resample(np.zeros(8, np.float32), 0)

@@ -128,3 +128,40 @@ class TestWelchDetrend:
             ops.csd(x, x, nfft=256, detrend="lin", impl="reference")
         with pytest.raises(ValueError, match="window length"):
             ops.welch(x, nfft=256, window=np.hanning(128))
+
+
+class TestWiener:
+    @pytest.mark.parametrize("k", [3, 5, 9])
+    def test_differential(self, rng, k):
+        x = rng.normal(size=300).astype(np.float32)
+        want = ref_smooth.wiener(x, k)
+        got = np.asarray(ops.wiener(x, k))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_explicit_noise_and_batch(self, rng):
+        x = rng.normal(size=(3, 200)).astype(np.float32)
+        want = ref_smooth.wiener(x, 5, 0.5)
+        got = np.asarray(ops.wiener(x, 5, 0.5))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_denoises(self, rng):
+        """Noisy slow ramp: the filter must cut the noise power."""
+        t = np.linspace(0, 1, 2048)
+        clean = np.sin(2 * np.pi * 2 * t)
+        noisy = (clean + 0.3 * rng.normal(size=2048)).astype(np.float32)
+        out = np.asarray(ops.wiener(noisy, 9))
+        assert np.mean((out - clean) ** 2) < 0.5 * np.mean(
+            (noisy - clean) ** 2)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError):
+            ops.wiener(np.zeros(8, np.float32), 4)
+
+
+def test_wiener_large_dc_offset(rng):
+    """Regression: one-pass variance cancels in f32 at large DC; the
+    two-pass form must keep matching the f64 oracle there."""
+    x = (1e4 + rng.normal(size=400)).astype(np.float32)
+    want = ref_smooth.wiener(x, 5)
+    got = np.asarray(ops.wiener(x, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-2)

@@ -306,3 +306,31 @@ class TestCsdCoherence:
         assert coh_dep[lo_band].min() > 0.95
         assert coh_ind.mean() < 0.2
         assert coh_dep.max() <= 1.0 + 1e-5
+
+
+class TestPeriodogram:
+    def test_matches_oracle_and_welch(self, rng):
+        from veles.simd_tpu.reference import spectral as refs
+
+        x = rng.normal(size=(2, 1024)).astype(np.float32)
+        got = np.asarray(ops.periodogram(x))
+        want = refs.periodogram(x)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-8)
+        # single full-length hann frame == welch at nfft=n
+        w = np.hanning(1024).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ops.periodogram(x, window=w)),
+            np.asarray(ops.welch(x, nfft=1024, window=w)),
+            rtol=1e-5, atol=1e-9)
+
+    def test_tone_bin(self):
+        n = 1024
+        x = np.sin(2 * np.pi * 64 * np.arange(n) / n).astype(np.float32)
+        p = np.asarray(ops.periodogram(x))
+        assert p.argmax() == 64
+
+    def test_detrend_param(self, rng):
+        x = (rng.normal(size=512) + 30).astype(np.float32)
+        p = np.asarray(ops.periodogram(x, detrend="constant"))
+        praw = np.asarray(ops.periodogram(x))
+        assert praw[0] > 1e3 * p[0]

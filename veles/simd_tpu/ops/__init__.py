@@ -38,19 +38,19 @@ from veles.simd_tpu.ops.wavelet import (  # noqa: F401
     wavelet_reconstruct, wavelet_reconstruct2D, wavelet_recycle_source,
     wavelet_validate_order)
 from veles.simd_tpu.ops.correlate import (  # noqa: F401
-    cross_correlate, cross_correlate_fft, cross_correlate_finalize,
-    cross_correlate_initialize, cross_correlate_overlap_save,
-    cross_correlate_simd)
+    cross_correlate, cross_correlate2D, cross_correlate_fft,
+    cross_correlate_finalize, cross_correlate_initialize,
+    cross_correlate_overlap_save, cross_correlate_simd)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, butter_sos, cheby1_sos, decimate, iir_stream_init,
     iir_stream_step, lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
-    firwin, resample_filter, resample_poly, upfirdn)
+    firwin, resample, resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.smooth import (  # noqa: F401
-    medfilt, savgol_coeffs, savgol_filter)
+    medfilt, savgol_coeffs, savgol_filter, wiener)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     coherence, csd, detrend, envelope, frame, hann_window, hilbert, istft,
-    overlap_add, spectrogram, stft, welch)
+    overlap_add, periodogram, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,

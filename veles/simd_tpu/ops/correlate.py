@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from veles.simd_tpu.config import resolve_impl
 # Import names directly: module-object access through the ops package would
@@ -55,3 +56,21 @@ def cross_correlate_fft(x, h, *, impl=None):
 
 def cross_correlate_overlap_save(x, h, *, impl=None):
     return cross_correlate(x, h, algorithm="overlap_save", impl=impl)
+
+
+def cross_correlate2D(x, h, *, algorithm: Optional[str] = None, impl=None):
+    """Full 2-D cross-correlation -> (..., H+kh-1, W+kw-1)
+    (scipy.signal.correlate2d mode="full" for real inputs): delegates to
+    :func:`ops.convolve2D` with the kernel flipped on both axes — the
+    same reverse-flag relationship the 1-D pair uses
+    (src/correlate.c:128-142's pattern, one dimension up). Leading axes
+    of ``x`` are batch."""
+    impl = resolve_impl(impl)
+    from veles.simd_tpu.ops.convolve import convolve2D
+
+    if np.ndim(h) != 2:
+        raise ValueError(f"h must be 2-D; got shape {np.shape(h)}")
+    if impl == "reference":  # full-precision taps for the f64 oracle
+        return convolve2D(x, np.asarray(h)[::-1, ::-1], impl="reference")
+    h = jnp.asarray(h, jnp.float32)
+    return convolve2D(x, h[::-1, ::-1], algorithm=algorithm, impl=impl)

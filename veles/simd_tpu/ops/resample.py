@@ -89,6 +89,39 @@ def upfirdn(x, h, up=1, down=1, *, impl=None):
     return _upfirdn_xla(x, h, int(up), int(down), h.shape[-1])
 
 
+@functools.partial(jax.jit, static_argnames=("num",))
+def _resample_fft_xla(x, num):
+    n = x.shape[-1]
+    m = min(num, n)
+    m2 = m // 2 + 1
+    X = jnp.fft.rfft(x)[..., :m2]
+    if m % 2 == 0 and num != n:
+        # the unpaired Nyquist-edge bin: folded double when
+        # downsampling, split half when upsampling (scipy's rule)
+        X = X.at[..., m // 2].multiply(2.0 if num < n else 0.5)
+    return jnp.fft.irfft(X * (num / n), n=num).astype(jnp.float32)
+
+
+def resample(x, num, *, impl=None):
+    """Fourier-method resampling to exactly ``num`` samples
+    (scipy.signal.resample, real input): truncate or zero-pad the
+    one-sided spectrum, with scipy's unpaired-Nyquist-bin fold. Assumes
+    the signal is periodic over its window; for FIR anti-aliasing
+    semantics use :func:`resample_poly`. Leading axes are batch; one
+    batched rfft/irfft pair on TPU."""
+    num = int(num)
+    if num < 1:
+        raise ValueError("num must be >= 1")
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        from scipy.signal import resample as _resample
+        return _resample(np.asarray(x, np.float64), num, axis=-1)
+    x = jnp.asarray(x, jnp.float32)
+    if num == x.shape[-1]:
+        return x
+    return _resample_fft_xla(x, num)
+
+
 def firwin(numtaps, cutoff, *, window="hamming", pass_zero=True):
     """Window-method FIR design (host-side, float64 scipy passthrough):
     the general-purpose companion of :func:`resample_filter` for callers

@@ -1,7 +1,7 @@
-"""Smoothing filters: sliding median and Savitzky-Golay.
+"""Smoothing filters: sliding median, Savitzky-Golay, adaptive Wiener.
 
 Framework extensions along the scipy.signal axis (the reference C
-library has no smoother family). Both reduce to TPU-friendly
+library has no smoother family). All reduce to TPU-friendly
 primitives:
 
 * ``medfilt`` — the gather-free framing view (``frame`` with hop 1)
@@ -13,6 +13,9 @@ primitives:
   the whole filter is one FIR correlation with host-designed
   coefficients (scipy.signal.savgol_coeffs, float64) plus an edge
   policy expressed as ``jnp.pad`` modes.
+* ``wiener`` — local mean/variance over the same frame view, then an
+  elementwise shrinkage toward the local mean where variance
+  approaches the noise power.
 
 Oracle: reference/smooth.py (scipy float64), tests/test_smooth.py.
 """
@@ -57,6 +60,41 @@ def medfilt(x, kernel_size=3, *, impl=None):
     if x.shape[-1] < 1:
         return x
     return _medfilt_xla(x, kernel_size)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "estimate_noise"))
+def _wiener_xla(x, k, noise, estimate_noise):
+    pad = [(0, 0)] * (x.ndim - 1) + [(k // 2, k // 2)]
+    xp = jnp.pad(x, pad)  # zero padding, scipy.signal.wiener's policy
+    win = frame(xp, k, 1)  # (..., n, k)
+    m = jnp.mean(win, axis=-1)
+    # two-pass variance: E[(x-m)^2], not E[x^2]-m^2 — the one-pass form
+    # catastrophically cancels in f32 on large-DC signals (raw ADC
+    # streams), silently degrading the filter to a boxcar mean
+    var = jnp.mean((win - m[..., None]) ** 2, axis=-1)
+    if estimate_noise:
+        # scipy estimates the noise power as the mean local variance
+        noise = jnp.mean(var, axis=-1, keepdims=True)
+    res = (x - m) * (1.0 - noise / jnp.maximum(var, 1e-30)) + m
+    return jnp.where(var < noise, m, res)
+
+
+def wiener(x, mysize=3, noise=None, *, impl=None):
+    """Adaptive Wiener filter over the last axis (scipy.signal.wiener
+    1-D semantics, zero-padded edges): local mean/variance in a
+    ``mysize`` window, shrinking toward the local mean where the local
+    variance approaches the noise power (estimated as the mean local
+    variance per signal when ``noise`` is None). Leading axes are
+    batch."""
+    mysize = int(mysize)
+    if mysize < 1 or mysize % 2 == 0:
+        raise ValueError(f"mysize must be odd and >= 1, got {mysize}")
+    if resolve_impl(impl) == "reference":
+        return _ref.wiener(x, mysize, noise)
+    x = jnp.asarray(x, jnp.float32)
+    est = noise is None
+    noise_arr = jnp.asarray(0.0 if est else noise, jnp.float32)
+    return _wiener_xla(x, mysize, noise_arr, est)
 
 
 def savgol_coeffs(window_length, polyorder, deriv=0, delta=1.0):

@@ -239,6 +239,25 @@ def detrend(x, type="linear", *, impl=None):
     return _detrend_xla(x, type)
 
 
+def periodogram(x, *, window=None, detrend=None, impl=None):
+    """Single-segment power spectral density -> float32 (..., n//2+1):
+    :func:`welch` with one full-length frame (``nfft = hop = n``), same
+    window-energy normalization (``sum(w^2) * n``) so the two
+    estimators agree by construction. ``window`` defaults to
+    rectangular (scipy.signal.periodogram's default); ``detrend`` as in
+    :func:`welch`."""
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _ref.periodogram(x, window=window,
+                                detrend=_psd_detrend_kind(detrend))
+    # delegate to welch with one full-length frame: agreement between
+    # the two estimators is structural, not two copies kept in sync
+    n = jnp.asarray(x).shape[-1]
+    w = (jnp.ones(n, jnp.float32) if window is None
+         else jnp.asarray(window, jnp.float32))
+    return welch(x, nfft=n, hop=n, window=w, detrend=detrend, impl=impl)
+
+
 def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None,
         detrend=None, impl=None):
     """Cross-spectral density -> complex64 (..., nfft//2+1): Welch's

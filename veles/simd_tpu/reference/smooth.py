@@ -26,3 +26,12 @@ def savgol_filter(x, window_length, polyorder, deriv=0, delta=1.0,
 
     return _savgol(np.asarray(x, np.float64), window_length, polyorder,
                    deriv=deriv, delta=delta, axis=-1, mode=mode)
+
+
+def wiener(x, mysize=3, noise=None):
+    from scipy.signal import wiener as _wiener
+
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1, x.shape[-1])
+    out = np.stack([_wiener(r, mysize, noise) for r in flat])
+    return out.reshape(x.shape)

@@ -104,6 +104,14 @@ def detrend(x, type="linear"):
     return _detrend(np.asarray(x, np.float64), axis=-1, type=type)
 
 
+def periodogram(x, *, window=None, detrend=None):
+    x = np.asarray(x, np.float64)
+    n = x.shape[-1]
+    w = np.ones(n) if window is None else np.asarray(window, np.float64)
+    s = _psd_frames(x, w, n, n, detrend)
+    return (np.abs(s) ** 2).mean(axis=-2) / (np.sum(w * w) * n)
+
+
 def csd(x, y, *, nfft: int = 512, hop: int | None = None, window=None,
         detrend=None):
     hop = nfft // 4 if hop is None else hop
