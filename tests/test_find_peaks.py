@@ -1,0 +1,170 @@
+"""find_peaks_fixed vs scipy.signal.find_peaks (the definitional
+oracle), across every condition family and their combinations."""
+
+import numpy as np
+import pytest
+from scipy.signal import find_peaks as sp_find_peaks
+
+from veles.simd_tpu import ops
+
+
+def unpack(res, count_only=False):
+    pos, val, count, props = res
+    pos, val, count = (np.asarray(pos), np.asarray(val), int(count))
+    return pos[:count], val[:count], count, {
+        k: np.asarray(v)[:count] for k, v in props.items()}
+
+
+def check_against_scipy(x, **kw):
+    pos, val, count, props = unpack(
+        ops.find_peaks_fixed(x, capacity=256, **kw))
+    want_pos, want_props = sp_find_peaks(x.astype(np.float64), **kw)
+    assert len(want_pos) <= 256, "raise the helper capacity"
+    np.testing.assert_array_equal(pos, want_pos)
+    np.testing.assert_allclose(val, x[want_pos], rtol=1e-6)
+    for name in ("prominences", "widths", "left_ips", "right_ips",
+                 "width_heights"):
+        if name in want_props and name in props:
+            np.testing.assert_allclose(props[name], want_props[name],
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+    for name in ("left_bases", "right_bases"):
+        if name in want_props and name in props:
+            np.testing.assert_array_equal(props[name],
+                                          want_props[name], err_msg=name)
+    return pos, props
+
+
+class TestPlainPeaks:
+    def test_simple(self, rng):
+        x = rng.normal(size=200).astype(np.float32)
+        check_against_scipy(x)
+
+    def test_plateaus_report_midpoint(self):
+        x = np.array([0, 1, 1, 1, 0, 2, 2, 0, 3, 0], np.float32)
+        check_against_scipy(x)
+
+    def test_edge_plateaus_are_not_peaks(self):
+        x = np.array([5, 5, 1, 2, 1, 7, 7], np.float32)
+        check_against_scipy(x)
+
+    def test_monotone_has_no_peaks(self):
+        x = np.arange(32, dtype=np.float32)
+        pos, _, count, _ = unpack(ops.find_peaks_fixed(x))
+        assert count == 0 and len(pos) == 0
+
+
+class TestConditions:
+    def test_height_scalar_and_interval(self, rng):
+        x = rng.normal(size=300).astype(np.float32)
+        check_against_scipy(x, height=0.5)
+        check_against_scipy(x, height=(-0.5, 1.0))
+
+    def test_threshold(self, rng):
+        x = rng.normal(size=300).astype(np.float32)
+        check_against_scipy(x, threshold=0.3)
+
+    def test_distance(self, rng):
+        x = rng.normal(size=400).astype(np.float32)
+        for d in (2, 5, 20):
+            check_against_scipy(x, distance=d)
+
+    def test_prominence(self, rng):
+        x = rng.normal(size=300).astype(np.float32)
+        check_against_scipy(x, prominence=0.5)
+        check_against_scipy(x, prominence=(0.2, 2.0))
+
+    def test_width(self, rng):
+        t = np.linspace(0, 6 * np.pi, 600)
+        x = (np.sin(t) + 0.1 * np.sin(13 * t)).astype(np.float32)
+        check_against_scipy(x, width=5)
+        check_against_scipy(x, width=2, rel_height=0.75)
+
+    def test_combined(self, rng):
+        x = rng.normal(size=500).astype(np.float32)
+        check_against_scipy(x, height=0.0, distance=4, prominence=0.3,
+                            width=1.0)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_fuzz(self, seed):
+        g = np.random.default_rng(9000 + seed)
+        n = int(g.integers(20, 800))
+        x = g.normal(size=n).astype(np.float32)
+        if seed % 2:
+            # plateau data has exact height ties; scipy's distance
+            # suppression breaks ties with an UNSTABLE argsort
+            # (quicksort in _select_by_peak_distance), so tie order is
+            # unspecified there — exercise prominence/width on plateaus
+            # and distance on tie-free data only
+            x = np.round(x * 3) / 3
+            check_against_scipy(x, prominence=0.2)
+        else:
+            check_against_scipy(x, prominence=0.2, distance=3)
+
+
+class TestContract:
+    def test_fixed_shapes_and_padding(self, rng):
+        x = rng.normal(size=100).astype(np.float32)
+        pos, val, count, props = ops.find_peaks_fixed(
+            x, capacity=8, prominence=0.0)
+        assert pos.shape == (8,) and val.shape == (8,)
+        assert all(v.shape == (8,) for v in props.values())
+        c = int(count)
+        assert np.all(np.asarray(pos)[c:] == -1)
+
+    def test_capacity_truncates(self, rng):
+        x = rng.normal(size=400).astype(np.float32)
+        pos, _, count, _ = ops.find_peaks_fixed(x, capacity=4)
+        assert int(count) <= 4
+
+    def test_jit_and_vmap(self, rng):
+        import jax
+
+        x = rng.normal(size=(3, 128)).astype(np.float32)
+        fn = jax.vmap(lambda r: ops.find_peaks_fixed(r, capacity=16)[:3])
+        pos, val, count = fn(x)
+        assert pos.shape == (3, 16)
+        for b in range(3):
+            want, _ = sp_find_peaks(x[b].astype(np.float64))
+            c = int(count[b])
+            np.testing.assert_array_equal(np.asarray(pos[b])[:c],
+                                          want[:min(len(want), 16)])
+
+    def test_reference_impl_agrees(self, rng):
+        x = rng.normal(size=200).astype(np.float32)
+        # place the threshold in the widest gap of the prominence
+        # distribution: a cutoff within f32 epsilon of some peak's
+        # prominence would flip that peak between the f32 device path
+        # and the f64 scipy path
+        _, all_props = sp_find_peaks(x.astype(np.float64), prominence=0)
+        proms = np.sort(all_props["prominences"])
+        gaps = np.diff(proms)
+        i = int(np.argmax(gaps))
+        cut = float((proms[i] + proms[i + 1]) / 2)
+        got = unpack(ops.find_peaks_fixed(x, prominence=cut))
+        ref = unpack(ops.find_peaks_fixed(x, prominence=cut,
+                                          impl="reference"))
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_allclose(got[3]["prominences"],
+                                   ref[3]["prominences"], rtol=1e-4)
+
+    def test_errors(self, rng):
+        with pytest.raises(ValueError):
+            ops.find_peaks_fixed(np.zeros((2, 50), np.float32))
+        with pytest.raises(ValueError):
+            ops.find_peaks_fixed(np.zeros(2, np.float32))
+        with pytest.raises(ValueError):
+            ops.find_peaks_fixed(np.zeros(50, np.float32), distance=0.5)
+
+
+def test_threshold_sweep_does_not_recompile(rng):
+    """Condition VALUES are traced data, not static code: sweeping a
+    cutoff must reuse one compiled program (review r3 finding)."""
+    from veles.simd_tpu.ops.find_peaks import _find_peaks_xla
+
+    x = rng.normal(size=256).astype(np.float32)
+    ops.find_peaks_fixed(x, prominence=0.1, distance=2)
+    before = _find_peaks_xla._cache_size()
+    for cut in (0.2, 0.3, 0.55):
+        ops.find_peaks_fixed(x, prominence=cut, distance=3)
+    assert _find_peaks_xla._cache_size() == before

@@ -29,6 +29,8 @@ MODULES = [
     "veles.simd_tpu.ops.normalize",
     "veles.simd_tpu.ops.resample",
     "veles.simd_tpu.ops.detect_peaks",
+    "veles.simd_tpu.ops.find_peaks",
+    "veles.simd_tpu.ops.smooth",
     "veles.simd_tpu.ops.wavelet",
     "veles.simd_tpu.ops.stream",
     "veles.simd_tpu.ops.spectral",

@@ -41,6 +41,8 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate2D, cross_correlate_fft,
     cross_correlate_finalize, cross_correlate_initialize,
     cross_correlate_overlap_save, cross_correlate_simd)
+from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
+    find_peaks_fixed)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, butter_sos, cheby1_sos, decimate, iir_stream_init,
     iir_stream_step, lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)

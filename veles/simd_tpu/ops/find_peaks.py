@@ -1,0 +1,322 @@
+"""scipy.signal.find_peaks, TPU-shaped: fixed capacity, no data-dependent
+shapes.
+
+The C-parity detector (ops/detect_peaks.py, src/detect_peaks.c:58-127)
+returns every strict extremum; scipy's ``find_peaks`` is the richer
+instrument users actually migrate from — plateau-aware maxima plus
+conditioning on height, threshold, distance, prominence and width. This
+module reproduces those semantics under XLA's static-shape rules:
+
+* Plateau maxima are found with two ``associative_scan`` cummax passes
+  (nearest value-change index on each side); a plateau is a peak when
+  both flanking values are lower, reported at its midpoint — exactly
+  scipy's ``_local_maxima_1d``.
+* Candidates compact into ``capacity`` slots (the one-hot MXU compaction
+  shared with detect_peaks); every later stage operates on the fixed
+  slot axis.
+* ``distance`` replays scipy's highest-first greedy suppression as a
+  ``lax.scan`` over slots in priority order (capacity steps, O(K) vector
+  work each).
+* ``prominence``/``width`` evaluate per-slot with full-signal masked
+  reductions via ``lax.map`` (O(n) per slot, O(n) live memory — not a
+  (K, n) tensor).
+
+Positions pad with -1 and property slots with 0 beyond ``count``, the
+detect_peaks_fixed convention. 1-D signals only (scipy's contract);
+``jax.vmap`` lifts it over batches.
+
+Oracle: scipy.signal.find_peaks via ``impl="reference"``
+(tests/test_find_peaks.py runs the differential).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.ops.detect_peaks import _compact_mask
+
+
+def _interval(arg):
+    """Normalize scipy's scalar-or-(min, max) condition arguments."""
+    if arg is None:
+        return None, None
+    if np.ndim(arg) == 0:
+        return float(arg), None
+    lo, hi = arg
+    return (None if lo is None else float(lo),
+            None if hi is None else float(hi))
+
+
+def _plateau_maxima(x):
+    """Boolean mask of plateau-aware local maxima at plateau midpoints
+    (scipy _local_maxima_1d semantics; signal edges are never peaks)."""
+    n = x.shape[-1]
+    idx = jnp.arange(n)
+    # nearest index <= i where the value changed (run start)
+    chg_l = jnp.concatenate([jnp.ones(1, bool), x[1:] != x[:-1]])
+    run_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(chg_l, idx, 0))
+    # nearest index >= i where the value changes after (run end)
+    chg_r = jnp.concatenate([x[:-1] != x[1:], jnp.ones(1, bool)])
+    rev = jnp.where(chg_r[::-1], idx, 0)  # idx here = n-1 - original pos
+    run_end = (n - 1) - jax.lax.associative_scan(jnp.maximum, rev)[::-1]
+    left_val = jnp.where(run_start == 0, jnp.inf,
+                         x[jnp.maximum(run_start - 1, 0)])
+    right_val = jnp.where(run_end == n - 1, jnp.inf,
+                          x[jnp.minimum(run_end + 1, n - 1)])
+    is_peak = (left_val < x) & (right_val < x)
+    mid = (run_start + run_end) // 2
+    return is_peak & (idx == mid)
+
+
+def _enforce_distance(pos, val, distance, capacity):
+    """scipy's greedy suppression: walk peaks highest-first (equal
+    heights later-index-first, scipy's reversed-argsort tie-break),
+    killing any unprocessed peak closer than ``distance``; returns the
+    keep mask. ``distance`` arrives pre-ceiled (scipy rounds up)."""
+    valid = pos >= 0
+    order = jnp.argsort(jnp.where(valid, val, -jnp.inf))[::-1]
+    slots = jnp.arange(capacity)
+
+    def body(killed, oi):
+        p = pos[oi]
+        alive = valid[oi] & ~killed[oi]
+        near = valid & (jnp.abs(pos - p) < distance) & (slots != oi)
+        return killed | (near & alive), None
+
+    killed, _ = jax.lax.scan(body, ~valid, order)
+    return valid & ~killed
+
+
+def _compact_slots(keep, columns, capacity):
+    """Order-preserving compaction along the fixed slot axis: drop slots
+    where ``keep`` is False, shifting survivors left in lockstep across
+    every (column, fill) pair. Returns (count, [compacted columns]).
+
+    Sort-and-take, not the one-hot float einsum: positions are int32
+    signal indices that a float32 dot would corrupt past 2^24, and the
+    slot axis is tiny (K gathers of K elements are trivial even where
+    gathers serialize)."""
+    slots = jnp.arange(capacity)
+    order = jnp.sort(jnp.where(keep, slots, capacity))
+    src = jnp.minimum(order, capacity - 1)
+    valid = order < capacity
+    out = [jnp.where(valid, jnp.take(v, src), fill) for v, fill in columns]
+    return jnp.sum(keep).astype(jnp.int32), out
+
+
+def _prom_width_one(x, rel_height):
+    """Per-slot prominence + width evaluator (closed over the signal)."""
+    n = x.shape[-1]
+    idx = jnp.arange(n)
+
+    def one(p):
+        ok = p >= 0
+        pc = jnp.maximum(p, 0)
+        h = x[pc]
+        higher_l = (idx < pc) & (x > h)
+        lb_bound = jnp.max(jnp.where(higher_l, idx, -1))  # exclusive
+        in_l = (idx > lb_bound) & (idx <= pc)
+        left_min = jnp.min(jnp.where(in_l, x, jnp.inf))
+        # among equal minima scipy keeps the occurrence CLOSEST to the
+        # peak (its scan walks outward with a strict <): max index left,
+        # min index right
+        left_base = jnp.max(
+            jnp.where(in_l & (x == left_min), idx, -1))
+        higher_r = (idx > pc) & (x > h)
+        rb_bound = jnp.min(jnp.where(higher_r, idx, n))
+        in_r = (idx >= pc) & (idx < rb_bound)
+        right_min = jnp.min(jnp.where(in_r, x, jnp.inf))
+        right_base = jnp.min(
+            jnp.where(in_r & (x == right_min), idx, n))
+        prom = h - jnp.maximum(left_min, right_min)
+
+        h_eval = h - rel_height * prom
+        cand_l = in_l & (idx < pc) & (x <= h_eval)
+        il = jnp.maximum(jnp.max(jnp.where(cand_l, idx, -1)), left_base)
+        xl = x[il]
+        xl1 = x[jnp.minimum(il + 1, n - 1)]
+        lip = jnp.where((xl < h_eval) & (xl1 != xl),
+                        il + (h_eval - xl) / (xl1 - xl),
+                        il.astype(jnp.float32))
+        cand_r = in_r & (idx > pc) & (x <= h_eval)
+        ir = jnp.minimum(jnp.min(jnp.where(cand_r, idx, n)), right_base)
+        xr = x[jnp.minimum(ir, n - 1)]
+        xr1 = x[jnp.maximum(ir - 1, 0)]
+        rip = jnp.where((xr < h_eval) & (xr1 != xr),
+                        ir - (h_eval - xr) / (xr1 - xr),
+                        ir.astype(jnp.float32))
+        width = rip - lip
+        z = jnp.float32(0)
+        return (jnp.where(ok, prom, z),
+                jnp.where(ok, left_base, -1),
+                jnp.where(ok, right_base, -1),
+                jnp.where(ok, width, z),
+                jnp.where(ok, h_eval, z),
+                jnp.where(ok, lip, z),
+                jnp.where(ok, rip, z))
+
+    return one
+
+
+# slots in the traced condition-value vector (threshold values are
+# data, not code: sweeping a cutoff must not recompile the pipeline)
+_HMIN, _HMAX, _TMIN, _TMAX, _DIST, _PMIN, _PMAX, _WMIN, _WMAX, _RELH = \
+    range(10)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "capacity", "flags", "has_distance", "need_prom"))
+def _find_peaks_xla(x, cv, capacity, flags, has_distance, need_prom):
+    """``cv`` is the traced (10,) condition-value vector (slots above);
+    ``flags`` the static presence tuple for the 8 interval bounds —
+    only which conditions exist shapes the program, never their
+    values."""
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    out_capacity = capacity
+    # the signal bounds the peak count; the compactors return min(n,
+    # capacity) slots, so run every stage at that width and pad the
+    # public (capacity,) contract back on at the end
+    capacity = min(capacity, n)
+    sel = _plateau_maxima(x)
+    if flags[_HMIN]:
+        sel &= x >= cv[_HMIN]
+    if flags[_HMAX]:
+        sel &= x <= cv[_HMAX]
+    if flags[_TMIN] or flags[_TMAX]:
+        tl = x - jnp.concatenate([x[:1], x[:-1]])
+        tr = x - jnp.concatenate([x[1:], x[-1:]])
+        if flags[_TMIN]:
+            sel &= jnp.minimum(tl, tr) >= cv[_TMIN]
+        if flags[_TMAX]:
+            sel &= jnp.maximum(tl, tr) <= cv[_TMAX]
+    pos, val, count = _compact_mask(sel, x, capacity)
+
+    if has_distance:
+        keep = _enforce_distance(pos, val, cv[_DIST], capacity)
+        count, (posf, valf) = _compact_slots(
+            keep, [(pos, -1), (val, 0.0)], capacity)
+        pos, val = posf.astype(jnp.int32), valf
+
+    props = {}
+    if need_prom:
+        prom, lbase, rbase, width, wh, lip, rip = jax.lax.map(
+            _prom_width_one(x, cv[_RELH]), pos)
+        keep = pos >= 0
+        if flags[_PMIN]:
+            keep &= prom >= cv[_PMIN]
+        if flags[_PMAX]:
+            keep &= prom <= cv[_PMAX]
+        if flags[_WMIN]:
+            keep &= width >= cv[_WMIN]
+        if flags[_WMAX]:
+            keep &= width <= cv[_WMAX]
+        count, cols = _compact_slots(
+            keep, [(pos, -1), (val, 0.0), (prom, 0.0), (lbase, -1),
+                   (rbase, -1), (width, 0.0), (wh, 0.0), (lip, 0.0),
+                   (rip, 0.0)], capacity)
+        pos = cols[0].astype(jnp.int32)
+        val = cols[1]
+        props = {"prominences": cols[2],
+                 "left_bases": cols[3].astype(jnp.int32),
+                 "right_bases": cols[4].astype(jnp.int32),
+                 "widths": cols[5],
+                 "width_heights": cols[6],
+                 "left_ips": cols[7],
+                 "right_ips": cols[8]}
+    if out_capacity > capacity:
+        pad = out_capacity - capacity
+
+        def widen(v, fill):
+            return jnp.pad(v, (0, pad), constant_values=fill)
+
+        pos = widen(pos, -1)
+        val = widen(val, 0)
+        props = {k: widen(v, -1 if k.endswith("bases") else 0)
+                 for k, v in props.items()}
+    return pos, val, count, props
+
+
+def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
+                     distance=None, prominence=None, width=None,
+                     rel_height=0.5, impl=None):
+    """scipy.signal.find_peaks with a fixed output capacity ->
+    ``(positions, values, count, properties)``.
+
+    ``positions`` is int32 (capacity,), ascending, -1 beyond ``count``;
+    ``values`` the peak heights; ``properties`` carries
+    prominences/left_bases/right_bases/widths/width_heights/left_ips/
+    right_ips (fixed (capacity,) arrays) whenever ``prominence`` or
+    ``width`` conditions are given, else is empty. Conditions accept a
+    scalar minimum or a ``(min, max)`` pair like scipy; filtering order
+    (height, threshold, distance, prominence, width) matches scipy, so
+    the kept set is identical whenever it fits ``capacity``. 1-D
+    signals (scipy's contract); use ``jax.vmap`` for batches.
+    """
+    if np.ndim(x) != 1:
+        raise ValueError(f"find_peaks_fixed is 1-D (scipy's contract); "
+                         f"got shape {np.shape(x)}; vmap for batches")
+    if np.shape(x)[-1] < 3:
+        raise ValueError("need at least 3 samples")
+    if distance is not None and distance < 1:
+        raise ValueError("distance must be >= 1")
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        return _find_peaks_reference(x, capacity, height, threshold,
+                                     distance, prominence, width,
+                                     rel_height)
+    x = jnp.asarray(x, jnp.float32)
+    bounds = [_interval(height), _interval(threshold),
+              _interval(prominence), _interval(width)]
+    flat = [b for pair in bounds for b in pair]
+    flags = tuple(b is not None for b in flat)
+    cv = np.zeros(10, np.float32)
+    cv[:8] = [0.0 if b is None else b for b in flat]
+    # vector layout: interval bounds land at _HMIN.._TMAX and
+    # _PMIN.._WMAX; reorder from [h, t, p, w] pairs to slot order
+    cv = np.array([cv[0], cv[1], cv[2], cv[3],
+                   0.0 if distance is None else float(np.ceil(distance)),
+                   cv[4], cv[5], cv[6], cv[7],
+                   float(rel_height)], np.float32)
+    flags = (flags[0], flags[1], flags[2], flags[3], False,
+             flags[4], flags[5], flags[6], flags[7], False)
+    need_prom = prominence is not None or width is not None
+    return _find_peaks_xla(x, jnp.asarray(cv), int(capacity), flags,
+                           distance is not None, need_prom)
+
+
+def _find_peaks_reference(x, capacity, height, threshold, distance,
+                          prominence, width, rel_height):
+    """scipy itself, padded to the fixed-capacity contract."""
+    from scipy.signal import find_peaks
+
+    peaks, props = find_peaks(
+        np.asarray(x, np.float64), height=height, threshold=threshold,
+        distance=distance, prominence=prominence, width=width,
+        rel_height=rel_height)
+    count = min(len(peaks), capacity)
+    pos = np.full(capacity, -1, np.int32)
+    val = np.zeros(capacity, np.float32)
+    pos[:count] = peaks[:count]
+    val[:count] = np.asarray(x, np.float64)[peaks[:count]]
+    out_props = {}
+    if prominence is not None or width is not None:
+        for name, fill, dt in (
+                ("prominences", 0.0, np.float32),
+                ("left_bases", -1, np.int32),
+                ("right_bases", -1, np.int32),
+                ("widths", 0.0, np.float32),
+                ("width_heights", 0.0, np.float32),
+                ("left_ips", 0.0, np.float32),
+                ("right_ips", 0.0, np.float32)):
+            arr = np.full(capacity, fill, dt)
+            if name in props:
+                arr[:count] = props[name][:count]
+            out_props[name] = arr
+    return pos, val, np.int32(count), out_props
