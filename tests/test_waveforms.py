@@ -1,0 +1,147 @@
+"""Waveform generators + design-verification helpers vs scipy."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+
+class TestChirp:
+    @pytest.mark.parametrize("method", ["linear", "quadratic",
+                                        "logarithmic", "hyperbolic"])
+    def test_matches_scipy(self, method):
+        from scipy.signal import chirp as sp_chirp
+
+        t = np.linspace(0, 2.0, 4000)
+        want = sp_chirp(t, 5.0, 2.0, 40.0, method=method, phi=30)
+        got = np.asarray(ops.chirp(t, 5.0, 2.0, 40.0, method=method,
+                                   phi=30))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_contracts(self):
+        t = np.linspace(0, 1, 16)
+        with pytest.raises(ValueError):
+            ops.chirp(t, 1, 1, 2, method="cubic")
+        with pytest.raises(ValueError):
+            ops.chirp(t, 0, 1, 2, method="logarithmic")
+
+
+@pytest.mark.parametrize("fn,kw", [
+    ("square", {"duty": 0.5}), ("square", {"duty": 0.2}),
+    ("sawtooth", {"width": 1.0}), ("sawtooth", {"width": 0.5}),
+    ("sawtooth", {"width": 0.0})])
+def test_square_sawtooth_match_scipy(fn, kw):
+    import scipy.signal as ss
+
+    # sample off the discontinuities: the jump sample's side is an
+    # f32-vs-f64 phase-rounding coin flip, not a semantic difference
+    t = np.linspace(0.013, 40.0, 3000)
+    want = getattr(ss, fn)(t, *kw.values())
+    got = np.asarray(getattr(ops, fn)(t, *kw.values()))
+    err = np.abs(got - want)
+    assert np.mean(err > 2e-3) < 0.01  # isolated jump samples only
+    assert np.median(err) < 1e-5
+
+
+def test_gausspulse_matches_scipy():
+    from scipy.signal import gausspulse as sp_gausspulse
+
+    t = np.linspace(-0.01, 0.01, 2001)
+    want = sp_gausspulse(t, fc=1000, bw=0.5)
+    got = np.asarray(ops.gausspulse(t, fc=1000, bw=0.5))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    with pytest.raises(ValueError):
+        ops.gausspulse(t, fc=-1)
+
+
+class TestFreqz:
+    def test_matches_scipy(self):
+        from scipy.signal import butter, freqz as sp_freqz
+
+        b, a = butter(5, 0.3)
+        w_ref, h_ref = sp_freqz(b, a, worN=512)
+        w, h = ops.freqz(b, a, 512)
+        np.testing.assert_allclose(w, w_ref, atol=1e-12)
+        np.testing.assert_allclose(h, h_ref, rtol=1e-9)
+
+    def test_fir_only(self):
+        h_taps = ops.firwin(21, 0.4)
+        w, h = ops.freqz(h_taps)
+        assert np.abs(h[0]) == pytest.approx(1.0, abs=1e-3)  # DC gain
+
+    def test_group_delay(self):
+        from scipy.signal import butter
+
+        b, a = butter(4, 0.25)
+        w, gd = ops.group_delay((b, a), 256)
+        assert w.shape == gd.shape == (256,)
+        assert np.all(np.isfinite(gd))
+
+
+class TestPeakPromWidths:
+    def test_standalone_prominences(self, rng):
+        from scipy.signal import find_peaks as sp_fp
+        from scipy.signal import peak_prominences as sp_pp
+
+        x = rng.normal(size=300).astype(np.float32)
+        peaks, _ = sp_fp(x.astype(np.float64))
+        want_p, want_lb, want_rb = sp_pp(x.astype(np.float64), peaks)
+        prom, lb, rb = ops.peak_prominences(x, peaks.astype(np.int32))
+        np.testing.assert_allclose(np.asarray(prom), want_p, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(lb), want_lb)
+        np.testing.assert_array_equal(np.asarray(rb), want_rb)
+
+    def test_standalone_widths(self, rng):
+        from scipy.signal import find_peaks as sp_fp
+        from scipy.signal import peak_widths as sp_pw
+
+        x = rng.normal(size=300).astype(np.float32)
+        peaks, _ = sp_fp(x.astype(np.float64))
+        want = sp_pw(x.astype(np.float64), peaks, rel_height=0.7)
+        got = ops.peak_widths(x, peaks.astype(np.int32), rel_height=0.7)
+        for g, w_ in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w_, rtol=1e-3,
+                                       atol=1e-3)
+
+
+def test_chirp_degenerate_constant_frequency():
+    """f0 == f1 on log/hyperbolic sweeps is a pure tone, not NaN
+    (review r3 finding; scipy special-cases identically)."""
+    from scipy.signal import chirp as sp_chirp
+
+    t = np.linspace(0, 1, 500)
+    for method in ("logarithmic", "hyperbolic"):
+        got = np.asarray(ops.chirp(t, 5.0, 1.0, 5.0, method=method))
+        want = sp_chirp(t, 5.0, 1.0, 5.0, method=method)
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+    # negative same-sign pair is valid (scipy's rule)
+    got = np.asarray(ops.chirp(t, -5.0, 1.0, -40.0, method="hyperbolic"))
+    want = sp_chirp(t, -5.0, 1.0, -40.0, method="hyperbolic")
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_duty_width_range_validated():
+    t = np.linspace(0, 10, 64)
+    with pytest.raises(ValueError):
+        ops.square(t, duty=1.3)
+    with pytest.raises(ValueError):
+        ops.sawtooth(t, width=-0.1)
+
+
+def test_peak_helpers_accept_padding(rng):
+    """-1-padded positions (find_peaks_fixed output) work on BOTH
+    backends (review r3 finding)."""
+    x = rng.normal(size=200).astype(np.float32)
+    pos, _, count, _ = ops.find_peaks_fixed(x, capacity=128)
+    pos = np.asarray(pos)
+    prom_d = np.asarray(ops.peak_prominences(x, pos)[0])
+    prom_r = np.asarray(ops.peak_prominences(x, pos,
+                                             impl="reference")[0])
+    c = int(count)
+    np.testing.assert_allclose(prom_d[:c], prom_r[:c], rtol=1e-4,
+                               atol=1e-5)
+    w_d = np.asarray(ops.peak_widths(x, pos)[0])
+    w_r = np.asarray(ops.peak_widths(x, pos, impl="reference")[0])
+    np.testing.assert_allclose(w_d[:c], w_r[:c], rtol=1e-3, atol=1e-3)

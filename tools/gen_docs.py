@@ -34,6 +34,7 @@ MODULES = [
     "veles.simd_tpu.ops.wavelet",
     "veles.simd_tpu.ops.stream",
     "veles.simd_tpu.ops.spectral",
+    "veles.simd_tpu.ops.waveforms",
     "veles.simd_tpu.models.matched_filter",
     "veles.simd_tpu.models.denoiser",
     "veles.simd_tpu.models.image",

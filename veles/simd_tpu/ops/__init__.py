@@ -42,10 +42,13 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate_finalize, cross_correlate_initialize,
     cross_correlate_overlap_save, cross_correlate_simd)
 from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
-    find_peaks_fixed)
+    find_peaks_fixed, peak_prominences, peak_widths)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
-    IirStreamState, butter_sos, cheby1_sos, decimate, iir_stream_init,
-    iir_stream_step, lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
+    IirStreamState, butter_sos, cheby1_sos, decimate, freqz,
+    group_delay, iir_stream_init, iir_stream_step, lfilter, sosfilt,
+    sosfiltfilt, sosfreqz, tf2sos)
+from veles.simd_tpu.ops.waveforms import (  # noqa: F401
+    chirp, gausspulse, sawtooth, square)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     firwin, resample, resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.smooth import (  # noqa: F401

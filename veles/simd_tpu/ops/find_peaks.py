@@ -291,6 +291,65 @@ def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
                            distance is not None, need_prom)
 
 
+@jax.jit
+def _prominences_xla(x, peaks):
+    # returning only the prominence triple lets XLA dead-code-eliminate
+    # the width/interpolation half of the shared evaluator
+    prom, lbase, rbase, *_ = jax.lax.map(
+        _prom_width_one(x, jnp.float32(0.5)), peaks)
+    return prom, lbase.astype(jnp.int32), rbase.astype(jnp.int32)
+
+
+@jax.jit
+def _widths_xla(x, peaks, rel_height):
+    _, _, _, width, wh, lip, rip = jax.lax.map(
+        _prom_width_one(x, rel_height), peaks)
+    return width, wh, lip, rip
+
+
+def _ref_padded(x, peaks, fn, fills):
+    """Run a scipy per-peak evaluator over the valid (>= 0) entries of a
+    possibly -1-padded index array, padding results back in place."""
+    peaks = np.asarray(peaks)
+    valid = peaks >= 0
+    results = fn(np.asarray(x, np.float64), peaks[valid])
+    out = []
+    for r, fill in zip(results, fills):
+        full = np.full(peaks.shape, fill, r.dtype)
+        full[valid] = r
+        out.append(full)
+    return tuple(out)
+
+
+def peak_prominences(x, peaks, *, impl=None):
+    """Prominence of each given peak index -> (prominences, left_bases,
+    right_bases), shapes matching ``peaks`` (scipy.signal
+    .peak_prominences semantics; bases use scipy's closest-to-peak
+    tie-break). ``peaks`` need not come from find_peaks_fixed — any
+    int32 index array works; -1 entries pass through padded on both
+    backends."""
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import peak_prominences as _pp
+        return _ref_padded(x, peaks, _pp, (0.0, -1, -1))
+    return _prominences_xla(jnp.asarray(x, jnp.float32),
+                            jnp.asarray(peaks))
+
+
+def peak_widths(x, peaks, *, rel_height=0.5, impl=None):
+    """Width of each given peak at ``rel_height`` of its prominence ->
+    (widths, width_heights, left_ips, right_ips), shapes matching
+    ``peaks`` (scipy.signal.peak_widths semantics); -1 entries pass
+    through padded on both backends."""
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import peak_widths as _pw
+
+        def fn(x64, pk):
+            return _pw(x64, pk, rel_height=rel_height)
+        return _ref_padded(x, peaks, fn, (0.0, 0.0, 0.0, 0.0))
+    return _widths_xla(jnp.asarray(x, jnp.float32), jnp.asarray(peaks),
+                       jnp.float32(rel_height))
+
+
 def _find_peaks_reference(x, capacity, height, threshold, distance,
                           prominence, width, rel_height):
     """scipy itself, padded to the fixed-capacity contract."""

@@ -298,6 +298,32 @@ def _sosfreqz_f64(sos64, n_freqs):
     return w, np.prod(num / den, axis=0)
 
 
+def freqz(b, a=1.0, n_freqs=512, *, impl=None):
+    """Frequency response of a transfer function -> (w, H) on scipy's
+    [0, pi) grid. Host-side float64 on every backend, like
+    :func:`sosfreqz` (design verification, not a device workload)."""
+    b = np.atleast_1d(np.asarray(b, np.float64))
+    a = np.atleast_1d(np.asarray(a, np.float64))
+    impl = resolve_impl(impl)
+    if impl == "reference":
+        from scipy.signal import freqz as _freqz
+        return _freqz(b, a, worN=n_freqs)
+    w = np.linspace(0.0, np.pi, int(n_freqs), endpoint=False)
+    z1 = np.exp(-1j * w)
+    num = np.polyval(b[::-1], z1)  # sum b[k] z^-k via Horner
+    den = np.polyval(a[::-1], z1)
+    return w, num / den
+
+
+def group_delay(system, n_freqs=512):
+    """Group delay of a (b, a) transfer function -> (w, gd) in samples
+    (host-side float64 scipy passthrough — the differentiation-based
+    estimator is pure design verification)."""
+    from scipy.signal import group_delay as _gd
+
+    return _gd(system, w=n_freqs)
+
+
 def sosfreqz(sos, n_freqs=512, *, impl=None):
     """Frequency response of a biquad cascade -> (w, H) with ``w`` on
     scipy's grid [0, pi) (radians/sample, endpoint excluded) and complex

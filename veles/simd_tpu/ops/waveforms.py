@@ -1,0 +1,109 @@
+"""Test-signal generators: chirp, square, sawtooth, Gaussian pulse.
+
+scipy.signal's waveform family, expressed as pure elementwise math over
+a time array — one fused VPU pass under jit, trivially batched and
+shardable (a generator is the cheapest possible op to produce directly
+on device; synthesizing on host and transferring would pay HBM/PCIe for
+nothing). Oracle: scipy.signal itself via ``impl="reference"``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.config import resolve_impl
+
+_CHIRP_METHODS = ("linear", "quadratic", "logarithmic", "hyperbolic")
+
+
+def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
+    """Swept-frequency cosine (scipy.signal.chirp): instantaneous
+    frequency runs f0 at t=0 to f1 at t=t1 along ``method`` (linear,
+    quadratic, logarithmic, hyperbolic). ``phi`` in degrees."""
+    if method not in _CHIRP_METHODS:
+        raise ValueError(f"method must be one of {_CHIRP_METHODS}, "
+                         f"got {method!r}")
+    if method in ("logarithmic", "hyperbolic") and f0 * f1 <= 0:
+        # scipy's own constraint: nonzero and same sign
+        raise ValueError(f"{method} chirp needs f0 and f1 nonzero "
+                         f"with the same sign")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import chirp as _chirp
+        return _chirp(np.asarray(t, np.float64), f0, t1, f1,
+                      method=method, phi=phi)
+    t = jnp.asarray(t, jnp.float32)
+    degenerate = f0 == f1  # host-side: f0/f1 are call-time scalars
+    f0 = jnp.float32(f0)
+    f1 = jnp.float32(f1)
+    t1 = jnp.float32(t1)
+    if method == "linear":
+        phase = f0 * t + (f1 - f0) / (2 * t1) * t * t
+    elif method == "quadratic":
+        phase = f0 * t + (f1 - f0) / (3 * t1 * t1) * t * t * t
+    elif degenerate:
+        # log/hyperbolic sweep to the same frequency IS a pure tone;
+        # the closed forms below divide by log(f1/f0)=0 / (f0-f1)=0
+        # (scipy special-cases this identically)
+        phase = f0 * t
+    elif method == "logarithmic":
+        # phase integral of f0 * (f1/f0)^(t/t1)
+        k = jnp.log(f1 / f0)
+        phase = f0 * t1 / k * (jnp.exp(t / t1 * k) - 1.0)
+    else:  # hyperbolic: f(t) = f0*f1*t1 / ((f0 - f1) t + f1 t1)
+        sing = -f1 * t1 / (f0 - f1)
+        phase = -f0 * sing * jnp.log(jnp.abs(1.0 - t / sing))
+    return jnp.cos(2 * jnp.pi * phase
+                   + jnp.float32(np.pi / 180) * jnp.float32(phi))
+
+
+def square(t, duty=0.5, *, impl=None):
+    """Square wave of period 2*pi (scipy.signal.square): +1 for the
+    first ``duty`` fraction of each cycle, -1 for the rest.
+    Out-of-range ``duty`` raises (scipy silently emits NaN)."""
+    if not 0 <= duty <= 1:
+        raise ValueError(f"duty must be in [0, 1], got {duty}")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import square as _square
+        return _square(np.asarray(t, np.float64), duty)
+    t = jnp.asarray(t, jnp.float32)
+    frac = jnp.mod(t, 2 * jnp.pi) / (2 * jnp.pi)
+    return jnp.where(frac < jnp.float32(duty), 1.0, -1.0).astype(
+        jnp.float32)
+
+
+def sawtooth(t, width=1.0, *, impl=None):
+    """Sawtooth/triangle wave of period 2*pi (scipy.signal.sawtooth):
+    rises -1 -> 1 over the first ``width`` fraction of the cycle, falls
+    back over the rest (width=0.5 is the symmetric triangle).
+    Out-of-range ``width`` raises (scipy silently emits NaN)."""
+    if not 0 <= width <= 1:
+        raise ValueError(f"width must be in [0, 1], got {width}")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import sawtooth as _sawtooth
+        return _sawtooth(np.asarray(t, np.float64), width)
+    t = jnp.asarray(t, jnp.float32)
+    w = jnp.float32(width)
+    frac = jnp.mod(t, 2 * jnp.pi) / (2 * jnp.pi)
+    rising = 2.0 * frac / jnp.maximum(w, 1e-30) - 1.0
+    falling = 1.0 - 2.0 * (frac - w) / jnp.maximum(1.0 - w, 1e-30)
+    return jnp.where(frac < w, rising, falling).astype(jnp.float32)
+
+
+def gausspulse(t, fc=1000.0, bw=0.5, bwr=-6.0, *, impl=None):
+    """Gaussian-modulated sinusoid (scipy.signal.gausspulse): carrier
+    ``fc`` under a Gaussian envelope with fractional bandwidth ``bw``
+    at ``bwr`` dB."""
+    if fc <= 0 or bw <= 0 or bwr >= 0:
+        raise ValueError("need fc > 0, bw > 0, bwr < 0")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import gausspulse as _gausspulse
+        return _gausspulse(np.asarray(t, np.float64), fc=fc, bw=bw,
+                           bwr=bwr)
+    # scipy's envelope parameterization: exp(-a t^2) with a chosen so
+    # the spectrum is bwr dB down at fc*bw/2 off-carrier
+    ref = np.power(10.0, bwr / 20.0)
+    a = -(np.pi * fc * bw) ** 2 / (4.0 * np.log(ref))
+    t = jnp.asarray(t, jnp.float32)
+    return (jnp.exp(-jnp.float32(a) * t * t)
+            * jnp.cos(2 * jnp.pi * jnp.float32(fc) * t))
