@@ -145,3 +145,38 @@ def test_peak_helpers_accept_padding(rng):
     w_d = np.asarray(ops.peak_widths(x, pos)[0])
     w_r = np.asarray(ops.peak_widths(x, pos, impl="reference")[0])
     np.testing.assert_allclose(w_d[:c], w_r[:c], rtol=1e-3, atol=1e-3)
+    # the padded region itself must come back as fills on BOTH backends
+    assert np.all(prom_d[c:] == 0) and np.all(prom_r[c:] == 0)
+    assert np.all(w_d[c:] == 0) and np.all(w_r[c:] == 0)
+    lb_d = np.asarray(ops.peak_prominences(x, pos)[1])
+    lb_r = np.asarray(ops.peak_prominences(x, pos, impl="reference")[1])
+    assert np.all(lb_d[c:] == -1) and np.all(lb_r[c:] == -1)
+    # out-of-range concrete indices raise on both backends
+    bad = np.array([len(x) + 5], np.int32)
+    with pytest.raises(ValueError):
+        ops.peak_prominences(x, bad)
+    with pytest.raises(ValueError):
+        ops.peak_widths(x, bad, impl="reference")
+
+
+def test_square_array_duty_pwm():
+    """scipy's canonical PWM pattern: array-valued duty broadcast
+    against t (review r3 finding)."""
+    import scipy.signal as ss
+
+    t = np.linspace(0.01, 20, 1500)
+    duty = 0.5 * (1 + 0.9 * np.sin(2 * np.pi * 0.05 * t))
+    want = ss.square(t, duty)
+    got = np.asarray(ops.square(t, duty))
+    assert np.mean(got != want) < 0.01  # isolated edge samples only
+
+
+def test_hyperbolic_chirp_opposite_signs():
+    from scipy.signal import chirp as sp_chirp
+
+    t = np.linspace(0, 1, 800)
+    got = np.asarray(ops.chirp(t, 5.0, 1.0, -40.0, method="hyperbolic"))
+    want = sp_chirp(t, 5.0, 1.0, -40.0, method="hyperbolic")
+    np.testing.assert_allclose(got, want, atol=2e-3)
+    # fc=0 gausspulse is the scipy-valid DC case
+    assert np.all(np.isfinite(np.asarray(ops.gausspulse(t, fc=0.0))))

@@ -255,9 +255,15 @@ def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
     right_ips (fixed (capacity,) arrays) whenever ``prominence`` or
     ``width`` conditions are given, else is empty. Conditions accept a
     scalar minimum or a ``(min, max)`` pair like scipy; filtering order
-    (height, threshold, distance, prominence, width) matches scipy, so
-    the kept set is identical whenever it fits ``capacity``. 1-D
-    signals (scipy's contract); use ``jax.vmap`` for batches.
+    (height, threshold, distance, prominence, width) matches scipy.
+
+    Sizing ``capacity``: candidates compact into the fixed slots right
+    after the cheap vector conditions (height/threshold), BEFORE
+    distance/prominence/width prune them — so capacity must cover the
+    candidate count at that stage, not just the final peak count;
+    overflow drops candidates from the right (left-compaction). When
+    everything fits, the kept set is identical to scipy's. 1-D signals
+    (scipy's contract); use ``jax.vmap`` for batches.
     """
     if np.ndim(x) != 1:
         raise ValueError(f"find_peaks_fixed is 1-D (scipy's contract); "
@@ -307,6 +313,23 @@ def _widths_xla(x, peaks, rel_height):
     return width, wh, lip, rip
 
 
+def _check_peak_indices(x, peaks):
+    """Host-side range check when ``peaks`` is concrete: the device
+    gather would silently clamp an out-of-range index to the signal
+    edge and return a plausible-looking result where scipy raises.
+    Traced inputs (inside jit/vmap) skip the check — there the clamp
+    behavior is documented."""
+    try:
+        pk = np.asarray(peaks)
+        n = np.shape(x)[-1]
+    except Exception:  # tracer: no concrete values to validate
+        return
+    if pk.size and (int(pk.max()) >= n or int(pk.min()) < -1):
+        raise ValueError(
+            f"peak indices must be in [-1, {n - 1}] (-1 = padding); "
+            f"got range [{int(pk.min())}, {int(pk.max())}]")
+
+
 def _ref_padded(x, peaks, fn, fills):
     """Run a scipy per-peak evaluator over the valid (>= 0) entries of a
     possibly -1-padded index array, padding results back in place."""
@@ -326,8 +349,10 @@ def peak_prominences(x, peaks, *, impl=None):
     right_bases), shapes matching ``peaks`` (scipy.signal
     .peak_prominences semantics; bases use scipy's closest-to-peak
     tie-break). ``peaks`` need not come from find_peaks_fixed — any
-    int32 index array works; -1 entries pass through padded on both
-    backends."""
+    in-range int32 index array works; -1 entries pass through padded on
+    both backends (out-of-range concrete indices raise; traced ones
+    clamp to the signal edge)."""
+    _check_peak_indices(x, peaks)
     if resolve_impl(impl) == "reference":
         from scipy.signal import peak_prominences as _pp
         return _ref_padded(x, peaks, _pp, (0.0, -1, -1))
@@ -339,7 +364,9 @@ def peak_widths(x, peaks, *, rel_height=0.5, impl=None):
     """Width of each given peak at ``rel_height`` of its prominence ->
     (widths, width_heights, left_ips, right_ips), shapes matching
     ``peaks`` (scipy.signal.peak_widths semantics); -1 entries pass
-    through padded on both backends."""
+    through padded on both backends (out-of-range concrete indices
+    raise; traced ones clamp to the signal edge)."""
+    _check_peak_indices(x, peaks)
     if resolve_impl(impl) == "reference":
         from scipy.signal import peak_widths as _pw
 

@@ -24,10 +24,13 @@ def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
     if method not in _CHIRP_METHODS:
         raise ValueError(f"method must be one of {_CHIRP_METHODS}, "
                          f"got {method!r}")
-    if method in ("logarithmic", "hyperbolic") and f0 * f1 <= 0:
-        # scipy's own constraint: nonzero and same sign
-        raise ValueError(f"{method} chirp needs f0 and f1 nonzero "
-                         f"with the same sign")
+    if method == "logarithmic" and f0 * f1 <= 0:
+        # scipy's constraint for the log sweep: nonzero, same sign
+        raise ValueError("logarithmic chirp needs f0 and f1 nonzero "
+                         "with the same sign")
+    if method == "hyperbolic" and (f0 == 0 or f1 == 0):
+        # scipy requires only nonzero here; opposite signs are valid
+        raise ValueError("hyperbolic chirp needs f0 and f1 nonzero")
     if resolve_impl(impl) == "reference":
         from scipy.signal import chirp as _chirp
         return _chirp(np.asarray(t, np.float64), f0, t1, f1,
@@ -59,31 +62,33 @@ def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
 
 def square(t, duty=0.5, *, impl=None):
     """Square wave of period 2*pi (scipy.signal.square): +1 for the
-    first ``duty`` fraction of each cycle, -1 for the rest.
-    Out-of-range ``duty`` raises (scipy silently emits NaN)."""
-    if not 0 <= duty <= 1:
+    first ``duty`` fraction of each cycle, -1 for the rest. ``duty``
+    may be an array broadcast against ``t`` (scipy's PWM pattern); an
+    out-of-range scalar raises (scipy silently emits NaN)."""
+    if np.ndim(duty) == 0 and not 0 <= duty <= 1:
         raise ValueError(f"duty must be in [0, 1], got {duty}")
     if resolve_impl(impl) == "reference":
         from scipy.signal import square as _square
         return _square(np.asarray(t, np.float64), duty)
     t = jnp.asarray(t, jnp.float32)
     frac = jnp.mod(t, 2 * jnp.pi) / (2 * jnp.pi)
-    return jnp.where(frac < jnp.float32(duty), 1.0, -1.0).astype(
-        jnp.float32)
+    return jnp.where(frac < jnp.asarray(duty, jnp.float32),
+                     1.0, -1.0).astype(jnp.float32)
 
 
 def sawtooth(t, width=1.0, *, impl=None):
     """Sawtooth/triangle wave of period 2*pi (scipy.signal.sawtooth):
     rises -1 -> 1 over the first ``width`` fraction of the cycle, falls
-    back over the rest (width=0.5 is the symmetric triangle).
-    Out-of-range ``width`` raises (scipy silently emits NaN)."""
-    if not 0 <= width <= 1:
+    back over the rest (width=0.5 is the symmetric triangle). ``width``
+    may be an array broadcast against ``t``; an out-of-range scalar
+    raises (scipy silently emits NaN)."""
+    if np.ndim(width) == 0 and not 0 <= width <= 1:
         raise ValueError(f"width must be in [0, 1], got {width}")
     if resolve_impl(impl) == "reference":
         from scipy.signal import sawtooth as _sawtooth
         return _sawtooth(np.asarray(t, np.float64), width)
     t = jnp.asarray(t, jnp.float32)
-    w = jnp.float32(width)
+    w = jnp.asarray(width, jnp.float32)
     frac = jnp.mod(t, 2 * jnp.pi) / (2 * jnp.pi)
     rising = 2.0 * frac / jnp.maximum(w, 1e-30) - 1.0
     falling = 1.0 - 2.0 * (frac - w) / jnp.maximum(1.0 - w, 1e-30)
@@ -94,8 +99,9 @@ def gausspulse(t, fc=1000.0, bw=0.5, bwr=-6.0, *, impl=None):
     """Gaussian-modulated sinusoid (scipy.signal.gausspulse): carrier
     ``fc`` under a Gaussian envelope with fractional bandwidth ``bw``
     at ``bwr`` dB."""
-    if fc <= 0 or bw <= 0 or bwr >= 0:
-        raise ValueError("need fc > 0, bw > 0, bwr < 0")
+    if fc < 0 or bw <= 0 or bwr >= 0:
+        # fc == 0 is scipy-valid (the pure-envelope DC case)
+        raise ValueError("need fc >= 0, bw > 0, bwr < 0")
     if resolve_impl(impl) == "reference":
         from scipy.signal import gausspulse as _gausspulse
         return _gausspulse(np.asarray(t, np.float64), fc=fc, bw=bw,
