@@ -311,3 +311,35 @@ class TestSosfreqz:
         # grid excludes pi: bin k is at w = pi*k/4096
         hi = np.abs(np.asarray(h))[int(round(f * 2 * 4096))]
         np.testing.assert_allclose(gain, hi, rtol=1e-2)
+
+
+def test_filtfilt_zero_phase(rng):
+    """(b, a) zero-phase twin: matches sosfiltfilt through tf2sos away
+    from the edge transients, and cancels group delay on a tone."""
+    from scipy.signal import butter
+
+    b, a = butter(4, 0.25)
+    x = rng.normal(size=(2, 2048)).astype(np.float32)
+    got = np.asarray(ops.filtfilt(b, a, x))
+    want = np.asarray(ops.sosfiltfilt(x, ops.tf2sos(b, a)))
+    mid = slice(200, -200)
+    np.testing.assert_allclose(got[..., mid], want[..., mid],
+                               rtol=1e-3, atol=1e-3)
+    # zero phase: a passband tone comes back unshifted
+    t = np.arange(4096)
+    tone = np.sin(2 * np.pi * 0.02 * t).astype(np.float32)
+    y = np.asarray(ops.filtfilt(b, a, tone))
+    lag = np.argmax(np.correlate(y[500:-500], tone[500:-500], "full")) \
+        - (len(y) - 1000 - 1)
+    assert abs(lag) <= 1
+
+
+def test_deconvolve_passthrough(rng):
+    from scipy.signal import deconvolve as sp_deconvolve
+
+    sig = rng.normal(size=50)
+    div = np.array([1.0, 0.5, 0.25])
+    q, r = ops.deconvolve(sig, div)
+    wq, wr = sp_deconvolve(sig, div)
+    np.testing.assert_allclose(q, wq, atol=1e-12)
+    np.testing.assert_allclose(r, wr, atol=1e-12)

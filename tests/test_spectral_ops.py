@@ -334,3 +334,54 @@ class TestPeriodogram:
         p = np.asarray(ops.periodogram(x, detrend="constant"))
         praw = np.asarray(ops.periodogram(x))
         assert praw[0] > 1e3 * p[0]
+
+
+class TestLombscargle:
+    def test_recovers_tone_from_irregular_samples(self, rng):
+        """The op's defining use: a tone sampled at random times has a
+        sharp periodogram peak at its angular frequency."""
+        n = 500
+        t = np.sort(rng.uniform(0, 100, n)).astype(np.float32)
+        w0 = 1.3
+        y = np.sin(w0 * t).astype(np.float32)
+        freqs = np.linspace(0.1, 3.0, 300).astype(np.float32)
+        p = np.asarray(ops.lombscargle(t, y, freqs))
+        assert abs(freqs[p.argmax()] - w0) < 0.02
+
+    @pytest.mark.parametrize("floating_mean", [False, True])
+    def test_matches_scipy(self, rng, floating_mean):
+        n = 200
+        t = np.sort(rng.uniform(0, 50, n))
+        y = np.sin(0.7 * t) + 0.5 * rng.normal(size=n)
+        freqs = np.linspace(0.05, 2.0, 128)
+        want = ops.lombscargle(t, y, freqs, floating_mean=floating_mean,
+                               impl="reference")
+        got = np.asarray(ops.lombscargle(t, y, freqs,
+                                         floating_mean=floating_mean))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+    def test_weights_and_contracts(self, rng):
+        n = 100
+        t = np.sort(rng.uniform(0, 20, n))
+        y = np.cos(1.1 * t)
+        freqs = np.linspace(0.2, 2.0, 64)
+        w = rng.uniform(0.5, 1.5, n)
+        want = ops.lombscargle(t, y, freqs, weights=w, impl="reference")
+        got = np.asarray(ops.lombscargle(t, y, freqs, weights=w))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+        with pytest.raises(ValueError):
+            ops.lombscargle(t, y[:-1], freqs)
+        with pytest.raises(ValueError):
+            ops.lombscargle(t, y, np.zeros((2, 2)))
+
+
+def test_window_and_lag_passthroughs():
+    import scipy.signal as ss
+
+    np.testing.assert_array_equal(ops.get_window("hamming", 32),
+                                  ss.get_window("hamming", 32))
+    np.testing.assert_array_equal(
+        ops.get_window(("kaiser", 8.0), 64),
+        ss.get_window(("kaiser", 8.0), 64))
+    np.testing.assert_array_equal(ops.correlation_lags(100, 30),
+                                  ss.correlation_lags(100, 30))

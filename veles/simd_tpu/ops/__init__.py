@@ -44,9 +44,9 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
 from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
     find_peaks_fixed, peak_prominences, peak_widths)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
-    IirStreamState, butter_sos, cheby1_sos, decimate, freqz,
-    group_delay, iir_stream_init, iir_stream_step, lfilter, sosfilt,
-    sosfiltfilt, sosfreqz, tf2sos)
+    IirStreamState, butter_sos, cheby1_sos, decimate, deconvolve,
+    filtfilt, freqz, group_delay, iir_stream_init, iir_stream_step,
+    lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
 from veles.simd_tpu.ops.waveforms import (  # noqa: F401
     chirp, gausspulse, sawtooth, square)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
@@ -54,8 +54,9 @@ from veles.simd_tpu.ops.resample import (  # noqa: F401
 from veles.simd_tpu.ops.smooth import (  # noqa: F401
     medfilt, savgol_coeffs, savgol_filter, wiener)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
-    coherence, csd, detrend, envelope, frame, hann_window, hilbert, istft,
-    overlap_add, periodogram, spectrogram, stft, welch)
+    coherence, correlation_lags, csd, detrend, envelope, frame,
+    get_window, hann_window, hilbert, istft, lombscargle, overlap_add,
+    periodogram, spectrogram, stft, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,

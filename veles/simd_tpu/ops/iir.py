@@ -298,6 +298,28 @@ def _sosfreqz_f64(sos64, n_freqs):
     return w, np.prod(num / den, axis=0)
 
 
+def filtfilt(b, a, x, *, impl=None, chunk=None):
+    """Zero-phase (b, a) filtering: :func:`lfilter` forward, reverse,
+    forward, reverse — the tf-coefficient twin of :func:`sosfiltfilt`,
+    with the same simplified contract (no edge padding or
+    initial-condition matching; the two ends carry transients)."""
+    impl = resolve_impl(impl)
+    fwd = lfilter(b, a, x, impl=impl, chunk=chunk)
+    return lfilter(b, a, fwd[..., ::-1], impl=impl,
+                   chunk=chunk)[..., ::-1]
+
+
+def deconvolve(signal, divisor):
+    """Polynomial long division -> (quotient, remainder)
+    (scipy.signal.deconvolve passthrough — sample-serial host logic
+    with no batched/device formulation worth owning)."""
+    from scipy.signal import deconvolve as _deconvolve
+
+    # no dtype cast: scipy handles complex/float itself, and a float64
+    # cast would silently drop imaginary parts
+    return _deconvolve(signal, divisor)
+
+
 def freqz(b, a=1.0, n_freqs=512, *, impl=None):
     """Frequency response of a transfer function -> (w, H) on scipy's
     [0, pi) grid. Host-side float64 on every backend, like

@@ -36,6 +36,23 @@ def hann_window(nfft: int, dtype=jnp.float32):
     return 0.5 - 0.5 * jnp.cos(2 * jnp.pi * n / nfft)
 
 
+def get_window(window, nfft: int, fftbins: bool = True):
+    """scipy.signal.get_window passthrough (host-side design): name or
+    (name, param) -> float64 taps for the spectral estimators' window
+    arguments."""
+    from scipy.signal import get_window as _get_window
+
+    return _get_window(window, nfft, fftbins=fftbins)
+
+
+def correlation_lags(in1_len: int, in2_len: int, mode: str = "full"):
+    """scipy.signal.correlation_lags passthrough: the lag axis matching
+    ``ops.cross_correlate``'s output."""
+    from scipy.signal import correlation_lags as _lags
+
+    return _lags(in1_len, in2_len, mode=mode)
+
+
 @functools.partial(jax.jit, static_argnames=("frame_length", "hop"))
 def frame(x, frame_length: int, hop: int):
     """Overlapped frames of the last axis -> (..., n_frames, frame_length),
@@ -297,6 +314,88 @@ def coherence(x, y, *, nfft: int = 512, hop: int | None = None,
     pxx = jnp.mean(jnp.abs(sx) ** 2, axis=-2)
     pyy = jnp.mean(jnp.abs(sy) ** 2, axis=-2)
     return (jnp.abs(pxy) ** 2 / (pxx * pyy + 1e-30)).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("floating_mean",))
+def _lombscargle_xla(t, y, freqs, w, floating_mean):
+    # scipy's tau-offset formulation (Press & Rybicki Eqs. 7-19): all
+    # the per-frequency sums are (n,)x(n,F) dots — MXU work, which is
+    # exactly why the irregular-sampling periodogram belongs on TPU
+    wt = t[:, None] * freqs[None, :]          # (n, F) phases
+    coswt = jnp.cos(wt)
+    sinwt = jnp.sin(wt)
+    Y = jnp.dot(w, y)
+    CC = jnp.dot(w, coswt * coswt)
+    SS = 1.0 - CC
+    CS = jnp.dot(w, coswt * sinwt)
+    if floating_mean:
+        C = jnp.dot(w, coswt)
+        S = jnp.dot(w, sinwt)
+        CC = CC - C * C
+        SS = SS - S * S
+        CS = CS - C * S
+    tau = 0.5 * jnp.arctan2(2.0 * CS, CC - SS)
+    # angle-difference identity on the already-materialized (n, F)
+    # trig tensors: four multiply-adds instead of two fresh
+    # transcendental passes over the kernel's largest arrays
+    cos_tau = jnp.cos(tau)
+    sin_tau = jnp.sin(tau)
+    coswt_tau = coswt * cos_tau + sinwt * sin_tau
+    sinwt_tau = sinwt * cos_tau - coswt * sin_tau
+    wy = w * y
+    YC = jnp.dot(wy, coswt_tau)
+    YS = jnp.dot(wy, sinwt_tau)
+    CC = jnp.dot(w, coswt_tau * coswt_tau)
+    SS = 1.0 - CC
+    if floating_mean:
+        C = jnp.dot(w, coswt_tau)
+        S = jnp.dot(w, sinwt_tau)
+        YC = YC - Y * C
+        YS = YS - Y * S
+        CC = CC - C * C
+        SS = SS - S * S
+    eps = jnp.float32(np.finfo(np.float32).epsneg)
+    CC = jnp.maximum(CC, eps)
+    SS = jnp.maximum(SS, eps)
+    # 2(a*YC + b*YS) is amplitude^2; scipy's default "power" units add
+    # the legacy N/4 factor (a unit tone peaks at N/4)
+    return (2.0 * (YC * YC / CC + YS * YS / SS)
+            * (t.shape[0] / 4.0))
+
+
+def lombscargle(t, y, freqs, *, weights=None, floating_mean=False,
+                impl=None):
+    """Lomb-Scargle periodogram for IRREGULARLY sampled data ->
+    (n_freqs,) power in scipy's legacy units (a unit-amplitude tone
+    peaks at N/4 — scipy.signal.lombscargle's default "power"
+    normalization, tau-offset formulation).
+
+    Every per-frequency statistic is an (n,) x (n, F) dot product —
+    contraction work the MXU eats, unlike FFT estimators this op cannot
+    use (no uniform grid to transform). float32 phases lose precision
+    when ``t * freq`` grows large: pre-center the time axis
+    (``t - t.mean()``) for long absolute time ranges.
+    """
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import lombscargle as _ls
+        return _ls(np.asarray(t, np.float64), np.asarray(y, np.float64),
+                   np.asarray(freqs, np.float64), weights=weights,
+                   floating_mean=floating_mean)
+    t = jnp.asarray(t, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    freqs = jnp.asarray(freqs, jnp.float32)
+    if t.ndim != 1 or t.shape != y.shape or t.shape[-1] == 0:
+        raise ValueError("t and y must be equal-length non-empty 1-D")
+    if freqs.ndim != 1 or freqs.shape[-1] == 0:
+        raise ValueError("freqs must be non-empty 1-D")
+    if weights is None:
+        w = jnp.full(t.shape, 1.0 / t.shape[-1], jnp.float32)
+    else:
+        w = jnp.asarray(weights, jnp.float32)
+        if w.shape != t.shape:
+            raise ValueError("weights must match t's shape")
+        w = w / jnp.sum(w)
+    return _lombscargle_xla(t, y, freqs, w, bool(floating_mean))
 
 
 @jax.jit
