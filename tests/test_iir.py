@@ -343,3 +343,55 @@ def test_deconvolve_passthrough(rng):
     wq, wr = sp_deconvolve(sig, div)
     np.testing.assert_allclose(q, wq, atol=1e-12)
     np.testing.assert_allclose(r, wr, atol=1e-12)
+
+
+class TestDesignPassthroughs:
+    def test_identity_with_scipy(self):
+        import scipy.signal as ss
+
+        np.testing.assert_array_equal(
+            ops.ellip(4, 0.5, 40, 0.3, output="sos"),
+            ss.ellip(4, 0.5, 40, 0.3, output="sos"))
+        np.testing.assert_array_equal(ops.iirnotch(0.2, 30),
+                                      ss.iirnotch(0.2, 30))
+        np.testing.assert_array_equal(
+            ops.remez(33, [0, 0.1, 0.2, 0.5], [1, 0], fs=1.0),
+            ss.remez(33, [0, 0.1, 0.2, 0.5], [1, 0], fs=1.0))
+        assert ops.buttord(0.2, 0.3, 1, 40) == ss.buttord(0.2, 0.3, 1, 40)
+        b, a = ss.butter(4, 0.3)
+        np.testing.assert_array_equal(ops.tf2zpk(b, a)[0],
+                                      ss.tf2zpk(b, a)[0])
+
+    def test_designed_filter_runs_on_device(self, rng):
+        """The loop that matters: scipy-name design -> device filter."""
+        sos = np.asarray(ops.ellip(6, 0.2, 60, 0.25, output="sos"))
+        x = rng.normal(size=1024).astype(np.float32)
+        got = np.asarray(ops.sosfilt(x, sos))
+        want = ref_iir.sosfilt(x, sos)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_sosfilt_zi_steady_state(self):
+        """Starting a stream from sosfilt_zi * x[0] removes the step
+        transient: a constant input yields the DC-gain output from the
+        first chunk (scipy's documented zi contract, wired into
+        IirStreamState)."""
+        import jax.numpy as jnp
+
+        sos = _sos(4, 0.2)
+        zi = ops.sosfilt_zi(sos)
+        x = np.full(256, 0.7, np.float32)
+        st = ops.IirStreamState(jnp.asarray(zi * x[0], jnp.float32))
+        _, y = ops.iir_stream_step(st, x, sos)
+        np.testing.assert_allclose(np.asarray(y), x, rtol=1e-4,
+                                   atol=1e-4)
+        # from-rest comparison: the transient IS there without zi
+        st0 = ops.iir_stream_init(sos)
+        _, y0 = ops.iir_stream_step(st0, x, sos)
+        assert abs(float(y0[0]) - 0.7) > 0.1
+
+    def test_lfilter_zi_via_tf2sos(self):
+        from scipy.signal import butter, lfilter_zi as sp_zi
+
+        b, a = butter(3, 0.3)
+        np.testing.assert_allclose(ops.lfilter_zi(b, a), sp_zi(b, a),
+                                   atol=1e-12)

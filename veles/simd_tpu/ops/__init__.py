@@ -44,9 +44,13 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
 from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
     find_peaks_fixed, peak_prominences, peak_widths)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
-    IirStreamState, butter_sos, cheby1_sos, decimate, deconvolve,
-    filtfilt, freqz, group_delay, iir_stream_init, iir_stream_step,
-    lfilter, sosfilt, sosfiltfilt, sosfreqz, tf2sos)
+    IirStreamState, bessel, bilinear, butter_sos, buttord, cheb1ord,
+    cheb2ord, cheby1_sos, cheby2, decimate, deconvolve, ellip, ellipord,
+    filtfilt, firls, firwin2, freqz, group_delay, iircomb, iirdesign,
+    iirfilter, iirnotch, iirpeak, iir_stream_init, iir_stream_step,
+    kaiser_atten, kaiser_beta, kaiserord, lfilter, lfilter_zi,
+    minimum_phase, remez, sos2tf, sos2zpk, sosfilt, sosfiltfilt,
+    sosfilt_zi, sosfreqz, tf2sos, tf2zpk, zpk2sos, zpk2tf)
 from veles.simd_tpu.ops.waveforms import (  # noqa: F401
     chirp, gausspulse, sawtooth, square)
 from veles.simd_tpu.ops.resample import (  # noqa: F401

@@ -216,6 +216,74 @@ def tf2sos(b, a):
     return _tf2sos(np.asarray(b, np.float64), np.asarray(a, np.float64))
 
 
+def _design_passthrough(name):
+    """Host-side float64 design passthrough: filter design is pure
+    host math (tiny, sequential, root-finding) — the device runs the
+    resulting coefficients, never the design."""
+    def fn(*args, **kwargs):
+        import scipy.signal
+
+        return getattr(scipy.signal, name)(*args, **kwargs)
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = (f"scipy.signal.{name} passthrough (host-side design; "
+                  f"feed the result to sosfilt/lfilter/iir_stream_*).")
+    return fn
+
+
+# the complete scipy design-helper surface, one passthrough each (under
+# scipy's own names; pass output="sos" for the cascade form the device
+# ops run): IIR prototypes, order estimators, representation
+# conversions, FIR design, and notch/peak/comb one-liners
+cheby2 = _design_passthrough("cheby2")
+ellip = _design_passthrough("ellip")
+bessel = _design_passthrough("bessel")
+iirfilter = _design_passthrough("iirfilter")
+iirdesign = _design_passthrough("iirdesign")
+buttord = _design_passthrough("buttord")
+cheb1ord = _design_passthrough("cheb1ord")
+cheb2ord = _design_passthrough("cheb2ord")
+ellipord = _design_passthrough("ellipord")
+zpk2sos = _design_passthrough("zpk2sos")
+sos2zpk = _design_passthrough("sos2zpk")
+sos2tf = _design_passthrough("sos2tf")
+tf2zpk = _design_passthrough("tf2zpk")
+zpk2tf = _design_passthrough("zpk2tf")
+bilinear = _design_passthrough("bilinear")
+iirnotch = _design_passthrough("iirnotch")
+iirpeak = _design_passthrough("iirpeak")
+iircomb = _design_passthrough("iircomb")
+remez = _design_passthrough("remez")
+firls = _design_passthrough("firls")
+firwin2 = _design_passthrough("firwin2")
+kaiserord = _design_passthrough("kaiserord")
+kaiser_beta = _design_passthrough("kaiser_beta")
+kaiser_atten = _design_passthrough("kaiser_atten")
+minimum_phase = _design_passthrough("minimum_phase")
+
+
+def sosfilt_zi(sos):
+    """Steady-state initial conditions for a unit-step input
+    (scipy.signal.sosfilt_zi, host-side float64): scale by the first
+    input sample and wrap in :class:`IirStreamState` to start a stream
+    at steady state instead of from rest —
+    ``IirStreamState(jnp.asarray(sosfilt_zi(sos) * x[0], jnp.float32))``
+    (broadcast a leading batch axis for batched streams)."""
+    from scipy.signal import sosfilt_zi as _zi
+
+    return _zi(_ref._check_sos(sos))
+
+
+def lfilter_zi(b, a):
+    """scipy.signal.lfilter_zi passthrough (host-side): steady-state
+    initial conditions in direct form — convert the filter with
+    :func:`tf2sos` and use :func:`sosfilt_zi` for the streaming layer's
+    state layout."""
+    from scipy.signal import lfilter_zi as _zi
+
+    return _zi(b, a)
+
+
 def lfilter(b, a, x, *, impl=None, chunk=None):
     """scipy.signal.lfilter semantics over the last axis (zero initial
     state); leading axes of ``x`` are batch.
