@@ -1,0 +1,123 @@
+"""Chirp-Z transform and band-zoomed FFT (scipy.signal.czt/zoom_fft).
+
+Bluestein's identity turns the z-transform along a spiral
+``z_k = A * W^-k`` into one FFT-sized circular convolution:
+
+    X[k] = W^(k^2/2) * ( (x[n] A^-n W^(n^2/2)) (*) W^(-n^2/2) )[k]
+
+so the device work is a batched complex rfft-length FFT pair — exactly
+the machinery XLA already owns (the same reason the FFT convolve leg
+aliases XLA, docs/parity.md). The chirp phase k^2/2 grows past float32's
+usable range almost immediately (k^2/2 ~ 1e6 at k ~ 1400), so the three
+chirp vectors are precomputed host-side in float64 with phases reduced
+mod 2*pi, then shipped to the device as complex64 constants — the
+device never evaluates a large-angle transcendental.
+
+``zoom_fft`` evaluates a dense DFT over just [f1, f2) without computing
+the full spectrum: the classic "more resolution in one band" tool.
+
+Oracle: scipy.signal.czt / zoom_fft via ``impl="reference"``
+(tests/test_czt.py differentials).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.config import resolve_impl
+
+
+@functools.lru_cache(maxsize=64)
+def _chirp_constants(n, m, w, a):
+    """Host-side float64 chirp vectors with mod-2pi phase reduction ->
+    (an (n,), conv kernel (L,), postmult (m,), L) as complex64/128.
+
+    ``w``/``a`` are complex scalars on the unit circle or off it; phases
+    are split from magnitudes so only magnitudes exponentiate. Cached:
+    a per-frame zoom loop with fixed (n, m, w, a) must not pay the
+    host-side f64 work or re-upload the constants every call."""
+    k = np.arange(max(n, m), dtype=np.float64)
+    k2h = k * k / 2.0
+    logw_mag, argw = np.log(np.abs(w)), np.angle(w)
+    loga_mag, arga = np.log(np.abs(a)), np.angle(a)
+    # W^(k^2/2): magnitude exp(k2h*log|w|), phase k2h*arg(w) mod 2pi
+    wk_phase = np.mod(k2h * argw, 2 * np.pi)
+    wk_mag = np.exp(k2h * logw_mag)
+    wk2 = wk_mag * np.exp(1j * wk_phase)            # W^(+k^2/2)
+    iwk2 = np.exp(-1j * wk_phase) / wk_mag          # W^(-k^2/2)
+    nn = np.arange(n, dtype=np.float64)
+    a_pow = np.exp(-nn * loga_mag) * np.exp(
+        1j * np.mod(-nn * arga, 2 * np.pi))          # A^-n
+    an = a_pow * wk2[:n]
+    # circular-convolution kernel: b[j] = W^(-j^2/2) for j in
+    # (-(n-1) .. m-1), laid out for an L-point FFT
+    L = int(2 ** np.ceil(np.log2(n + m - 1)))
+    kern = np.zeros(L, np.complex128)
+    kern[:m] = iwk2[:m]
+    if n > 1:
+        kern[L - (n - 1):] = iwk2[1:n][::-1]
+    kern_fft = np.fft.fft(kern).astype(np.complex64)
+    return (an.astype(np.complex64), kern_fft,
+            wk2[:m].astype(np.complex64), L)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "L"))
+def _czt_xla(x, an, kern_fft, post, m, L):
+    y = x.astype(jnp.complex64) * an
+    yf = jnp.fft.fft(y, n=L, axis=-1)
+    conv = jnp.fft.ifft(yf * kern_fft, axis=-1)
+    return conv[..., :m] * post
+
+
+def czt(x, m=None, w=None, a=1 + 0j, *, impl=None):
+    """Chirp-Z transform along ``z_k = a * w^-k`` (k = 0..m-1) ->
+    complex64 (..., m); scipy.signal.czt semantics (``w`` defaults to
+    the unit-circle m-point DFT step). Leading axes of ``x`` are batch;
+    the whole batch rides one FFT convolution."""
+    return _czt_impl(x, m, w, a, impl)
+
+
+def _czt_impl(x, m, w, a, impl):
+    n = np.shape(x)[-1]
+    if n == 0:
+        raise ValueError("x must be non-empty along the last axis")
+    m = int(n if m is None else m)
+    if m < 1:
+        raise ValueError("m must be >= 1")
+    if w is None:
+        w = np.exp(-2j * np.pi / m)
+    w = complex(w)
+    a = complex(a)
+    if w == 0 or a == 0:
+        raise ValueError("w and a must be nonzero")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import czt as _czt
+        return _czt(np.asarray(x), m=m, w=w, a=a, axis=-1)
+    an, kern_fft, post, L = _chirp_constants(n, m, w, a)
+    return _czt_xla(jnp.asarray(x), jnp.asarray(an),
+                    jnp.asarray(kern_fft), jnp.asarray(post), m, L)
+
+
+def zoom_fft(x, fn, m=None, *, fs=2, impl=None):
+    """Dense DFT over just the band [f1, f2) -> complex64 (..., m)
+    (scipy.signal.zoom_fft): ``fn`` is (f1, f2) or a scalar f2 (band
+    from 0), frequencies in units where ``fs`` is the sampling rate.
+    Resolution beyond the FFT grid without computing the full spectrum.
+    """
+    n = np.shape(x)[-1]
+    if np.ndim(fn) == 0:
+        f1, f2 = 0.0, float(fn)
+    else:
+        f1, f2 = (float(v) for v in fn)
+    m = int(n if m is None else m)
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import zoom_fft as _zoom
+        return _zoom(np.asarray(x), [f1, f2] if np.ndim(fn) else f2,
+                     m=m, fs=fs, axis=-1)
+    w = np.exp(-2j * np.pi * (f2 - f1) / (m * fs))
+    a = np.exp(2j * np.pi * f1 / fs)
+    return _czt_impl(x, m, w, a, impl)

@@ -216,50 +216,58 @@ def tf2sos(b, a):
     return _tf2sos(np.asarray(b, np.float64), np.asarray(a, np.float64))
 
 
-def _design_passthrough(name):
+def _design_passthrough(name, use):
     """Host-side float64 design passthrough: filter design is pure
     host math (tiny, sequential, root-finding) — the device runs the
-    resulting coefficients, never the design."""
+    resulting coefficients, never the design. ``use`` states what the
+    result feeds (the categories return different things)."""
     def fn(*args, **kwargs):
         import scipy.signal
 
         return getattr(scipy.signal, name)(*args, **kwargs)
     fn.__name__ = name
     fn.__qualname__ = name
-    fn.__doc__ = (f"scipy.signal.{name} passthrough (host-side design; "
-                  f"feed the result to sosfilt/lfilter/iir_stream_*).")
+    fn.__doc__ = f"scipy.signal.{name} passthrough (host-side): {use}"
     return fn
 
 
-# the complete scipy design-helper surface, one passthrough each (under
-# scipy's own names; pass output="sos" for the cascade form the device
-# ops run): IIR prototypes, order estimators, representation
-# conversions, FIR design, and notch/peak/comb one-liners
-cheby2 = _design_passthrough("cheby2")
-ellip = _design_passthrough("ellip")
-bessel = _design_passthrough("bessel")
-iirfilter = _design_passthrough("iirfilter")
-iirdesign = _design_passthrough("iirdesign")
-buttord = _design_passthrough("buttord")
-cheb1ord = _design_passthrough("cheb1ord")
-cheb2ord = _design_passthrough("cheb2ord")
-ellipord = _design_passthrough("ellipord")
-zpk2sos = _design_passthrough("zpk2sos")
-sos2zpk = _design_passthrough("sos2zpk")
-sos2tf = _design_passthrough("sos2tf")
-tf2zpk = _design_passthrough("tf2zpk")
-zpk2tf = _design_passthrough("zpk2tf")
-bilinear = _design_passthrough("bilinear")
-iirnotch = _design_passthrough("iirnotch")
-iirpeak = _design_passthrough("iirpeak")
-iircomb = _design_passthrough("iircomb")
-remez = _design_passthrough("remez")
-firls = _design_passthrough("firls")
-firwin2 = _design_passthrough("firwin2")
-kaiserord = _design_passthrough("kaiserord")
-kaiser_beta = _design_passthrough("kaiser_beta")
-kaiser_atten = _design_passthrough("kaiser_atten")
-minimum_phase = _design_passthrough("minimum_phase")
+# the complete scipy design-helper surface, one passthrough each under
+# scipy's own names, grouped by what the result feeds
+_USE_IIR = ("IIR design; pass output='sos' and feed the cascade to "
+            "sosfilt/sosfiltfilt/iir_stream_*.")
+_USE_ORD = ("order estimator; feed (order, Wn) to the matching design "
+            "function, not to a filter.")
+_USE_CONV = "representation conversion between zpk/sos/tf forms."
+_USE_BA = "(b, a) design; feed to lfilter/filtfilt or via tf2sos."
+_USE_FIR = "FIR tap design; feed to convolve/lfilter/upfirdn."
+_USE_PARAM = "window-design parameter helper; returns scalars."
+
+cheby2 = _design_passthrough("cheby2", _USE_IIR)
+ellip = _design_passthrough("ellip", _USE_IIR)
+bessel = _design_passthrough("bessel", _USE_IIR)
+iirfilter = _design_passthrough("iirfilter", _USE_IIR)
+iirdesign = _design_passthrough("iirdesign", _USE_IIR)
+buttord = _design_passthrough("buttord", _USE_ORD)
+cheb1ord = _design_passthrough("cheb1ord", _USE_ORD)
+cheb2ord = _design_passthrough("cheb2ord", _USE_ORD)
+ellipord = _design_passthrough("ellipord", _USE_ORD)
+zpk2sos = _design_passthrough("zpk2sos", _USE_CONV)
+sos2zpk = _design_passthrough("sos2zpk", _USE_CONV)
+sos2tf = _design_passthrough("sos2tf", _USE_CONV)
+tf2zpk = _design_passthrough("tf2zpk", _USE_CONV)
+zpk2tf = _design_passthrough("zpk2tf", _USE_CONV)
+bilinear = _design_passthrough("bilinear", _USE_CONV)
+iirnotch = _design_passthrough("iirnotch", _USE_BA)
+iirpeak = _design_passthrough("iirpeak", _USE_BA)
+iircomb = _design_passthrough("iircomb", _USE_BA)
+remez = _design_passthrough("remez", _USE_FIR)
+firls = _design_passthrough("firls", _USE_FIR)
+firwin2 = _design_passthrough("firwin2", _USE_FIR)
+minimum_phase = _design_passthrough("minimum_phase", _USE_FIR)
+kaiserord = _design_passthrough(
+    "kaiserord", "Kaiser estimator; returns (numtaps, beta) for firwin.")
+kaiser_beta = _design_passthrough("kaiser_beta", _USE_PARAM)
+kaiser_atten = _design_passthrough("kaiser_atten", _USE_PARAM)
 
 
 def sosfilt_zi(sos):
