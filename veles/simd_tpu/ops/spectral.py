@@ -381,6 +381,14 @@ def lombscargle(t, y, freqs, *, weights=None, floating_mean=False,
         return _ls(np.asarray(t, np.float64), np.asarray(y, np.float64),
                    np.asarray(freqs, np.float64), weights=weights,
                    floating_mean=floating_mean)
+    t, y, freqs, w = _lombscargle_args(t, y, freqs, weights)
+    return _lombscargle_xla(t, y, freqs, w, bool(floating_mean))
+
+
+def _lombscargle_args(t, y, freqs, weights):
+    """Shared validation + weight normalization for the single-device op
+    and parallel.lombscargle_sharded — bad shapes must raise the same
+    clear ValueError on both, not a traced-shape error."""
     t = jnp.asarray(t, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     freqs = jnp.asarray(freqs, jnp.float32)
@@ -395,7 +403,7 @@ def lombscargle(t, y, freqs, *, weights=None, floating_mean=False,
         if w.shape != t.shape:
             raise ValueError("weights must match t's shape")
         w = w / jnp.sum(w)
-    return _lombscargle_xla(t, y, freqs, w, bool(floating_mean))
+    return t, y, freqs, w
 
 
 @jax.jit

@@ -295,3 +295,35 @@ def detect_peaks_fixed_sharded(data, extremum_type=None, *, capacity, mesh,
                                        capacity=capacity, impl="xla"),
         mesh, axis, out="batch", batch_axis=batch_axis)
     return fn(jnp.asarray(data, jnp.float32))
+
+
+def lombscargle_sharded(t, y, freqs, *, mesh, axis="freq", weights=None,
+                        floating_mean=False):
+    """Lomb-Scargle periodogram with the FREQUENCY axis sharded over the
+    mesh -> (n_freqs,) power, sharded along ``axis``.
+
+    The natural distributed decomposition is neither batch nor sequence:
+    every frequency's statistics need the full (t, y) series (so t/y
+    replicate — they are small next to the (n, F) trig workspace), while
+    frequencies are embarrassingly parallel — each device evaluates its
+    freq slice with zero collectives, cutting the dominant (n, F_local)
+    workspace and MXU work per device by the mesh size.
+    """
+    from veles.simd_tpu.ops.spectral import (_lombscargle_args,
+                                             _lombscargle_xla)
+
+    t, y, freqs, w = _lombscargle_args(t, y, freqs, weights)
+    n_shards = mesh.shape[axis]
+    if freqs.shape[-1] % n_shards:
+        raise ValueError(
+            f"len(freqs) ({freqs.shape[-1]}) must divide the {axis!r} "
+            f"mesh axis ({n_shards}); pad the frequency grid")
+
+    def local(t_rep, y_rep, w_rep, freqs_loc):
+        return _lombscargle_xla(t_rep, y_rep, freqs_loc, w_rep,
+                                bool(floating_mean))
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(axis)),
+                   out_specs=P(axis))
+    return fn(t, y, w, freqs)
