@@ -1,0 +1,110 @@
+"""Continuous wavelet transform vs the float64 direct-convolution
+oracle, plus the physical properties that define the scalogram."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import ops
+
+
+class TestWaveletTaps:
+    def test_ricker_admissibility(self):
+        """Zero mean (admissibility) and the documented normalization."""
+        psi = ops.ricker(101, 4.0)
+        assert abs(psi.sum()) < 1e-10
+        assert psi[50] == pytest.approx(
+            2.0 / (np.sqrt(3.0 * 4.0) * np.pi ** 0.25))
+
+    def test_morlet2_center_frequency(self):
+        """The FFT peak of morlet2(s) sits at w/(2 pi s) cycles/sample."""
+        s, w = 8.0, 5.0
+        psi = ops.morlet2(256, s, w=w)
+        spec = np.abs(np.fft.fft(psi, 4096))
+        f_peak = np.argmax(spec[:2048]) / 4096
+        assert f_peak == pytest.approx(w / (2 * np.pi * s), abs=2e-3)
+
+
+class TestCwt:
+    @pytest.mark.parametrize("wavelet", ["ricker", "morlet2"])
+    def test_matches_oracle(self, rng, wavelet):
+        x = rng.normal(size=256).astype(np.float32)
+        scales = (1.0, 3.0, 7.5, 20.0)
+        want = ops.cwt(x, scales, wavelet, impl="reference")
+        got = np.asarray(ops.cwt(x, scales, wavelet))
+        assert got.shape == want.shape == (4, 256)
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
+
+    def test_batched(self, rng):
+        x = rng.normal(size=(2, 3, 128)).astype(np.float32)
+        want = ops.cwt(x, (2.0, 5.0), impl="reference")
+        got = np.asarray(ops.cwt(x, (2.0, 5.0)))
+        assert got.shape == (2, 3, 2, 128)
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
+
+    def test_long_wavelet_cap(self, rng):
+        """Scales where 10*a exceeds n: the wavelet length caps at n
+        (the scipy contract's min(10*a, n))."""
+        x = rng.normal(size=100).astype(np.float32)
+        want = ops.cwt(x, (50.0,), impl="reference")
+        got = np.asarray(ops.cwt(x, (50.0,)))
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
+
+    def test_ridge_tracks_tone_scale(self):
+        """Scalogram physics: a pure tone's energy ridge sits at the
+        scale whose morlet2 center frequency matches the tone."""
+        n = 2048
+        f0 = 0.03  # cycles/sample
+        x = np.sin(2 * np.pi * f0 * np.arange(n)).astype(np.float32)
+        w = 5.0
+        scales = tuple(np.geomspace(4, 120, 40))
+        mag = np.abs(np.asarray(ops.cwt(x, scales, "morlet2", w=w)))
+        ridge = scales[int(np.argmax(mag[:, n // 2]))]
+        expected = w / (2 * np.pi * f0)
+        assert abs(ridge - expected) / expected < 0.12
+
+    def test_impulse_reproduces_wavelet(self):
+        """CWT of a centered impulse returns the (conjugate-reversed)
+        wavelet itself at each scale — the kernel readback identity."""
+        n = 257
+        x = np.zeros(n, np.float32)
+        x[n // 2] = 1.0
+        a = 6.0
+        got = np.asarray(ops.cwt(x, (a,)))[0]
+        psi = ops.ricker(int(10 * a), a)
+        m = len(psi)
+        want = np.zeros(n)
+        lo = n // 2 - (m - 1) // 2
+        want[lo:lo + m] = psi[::-1]
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_contracts(self, rng):
+        x = rng.normal(size=64).astype(np.float32)
+        with pytest.raises(ValueError):
+            ops.cwt(x, (2.0,), "haar")
+        with pytest.raises(ValueError):
+            ops.cwt(x, (-1.0,))
+        with pytest.raises(ValueError):
+            ops.cwt(x, ())
+
+
+def test_complex_input_supported(rng):
+    """Analytic/IQ input keeps its imaginary part (review r3 finding):
+    CWT is linear, so cwt(hilbert(x)) == cwt(x) + 1j*cwt(imag part)."""
+    x = rng.normal(size=256).astype(np.float32)
+    xa = np.asarray(ops.hilbert(x))  # complex64 analytic signal
+    got = np.asarray(ops.cwt(xa, (3.0, 9.0)))
+    want = ops.cwt(xa, (3.0, 9.0), impl="reference")
+    assert got.dtype == np.complex64
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
+    # linearity cross-check: real part of the transform of the real part
+    re = np.asarray(ops.cwt(xa.real.astype(np.float32), (3.0, 9.0)))
+    np.testing.assert_allclose(got.real, re, atol=1e-4 * scale)
+
+
+def test_tiny_scale_rejected(rng):
+    with pytest.raises(ValueError, match="0.1"):
+        ops.cwt(rng.normal(size=64).astype(np.float32), (0.05,))

@@ -25,6 +25,7 @@ MODULES = [
     "veles.simd_tpu.ops.matrix",
     "veles.simd_tpu.ops.convolve",
     "veles.simd_tpu.ops.correlate",
+    "veles.simd_tpu.ops.cwt",
     "veles.simd_tpu.ops.czt",
     "veles.simd_tpu.ops.iir",
     "veles.simd_tpu.ops.normalize",

@@ -41,6 +41,8 @@ from veles.simd_tpu.ops.correlate import (  # noqa: F401
     cross_correlate, cross_correlate2D, cross_correlate_fft,
     cross_correlate_finalize, cross_correlate_initialize,
     cross_correlate_overlap_save, cross_correlate_simd)
+from veles.simd_tpu.ops.cwt import (  # noqa: F401
+    cwt, morlet2, ricker)
 from veles.simd_tpu.ops.czt import czt, zoom_fft  # noqa: F401
 from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
     find_peaks_fixed, peak_prominences, peak_widths)

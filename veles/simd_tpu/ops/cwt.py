@@ -1,0 +1,124 @@
+"""Continuous wavelet transform: the whole scale bank in one batched
+FFT convolution.
+
+The discrete engine (ops/wavelet.py) covers the decimated/stationary
+transforms the reference implements; the CWT is the scalogram
+instrument on top — correlate the signal with a scaled wavelet at every
+scale (the scipy.signal.cwt contract, kept alive here after scipy
+removed it in 1.15; oracle reference/cwt.py).
+
+TPU formulation: a per-scale ``np.convolve(..., mode='same')`` loop is
+S separate convolutions with S different kernel lengths. Instead, every
+scale's conj-reversed wavelet embeds into one L-point buffer
+(L = next_pow2(n + max_len - 1)) circularly pre-rolled by its own
+``(m-1)//2`` group delay, so ONE broadcast FFT multiply
+
+    out = ifft(fft(x)[..., None, :] * BANK_FFT)[..., :n]
+
+yields every scale's 'same'-mode output at a common alignment — the
+scale axis rides the batch dimensions of XLA's FFT, and the wavelet
+bank FFT is precomputed host-side in float64 (and cached per
+(wavelet, scales, n)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.config import resolve_impl
+from veles.simd_tpu.reference import cwt as _ref
+
+_WAVELETS = {"ricker": _ref.ricker, "morlet2": _ref.morlet2}
+
+
+def ricker(points, a):
+    """Mexican-hat wavelet taps (host-side float64; reference/cwt.py)."""
+    return _ref.ricker(points, a)
+
+
+def morlet2(points, s, w=5.0):
+    """Complex Morlet wavelet taps (host-side float64)."""
+    return _ref.morlet2(points, s, w=w)
+
+
+@functools.lru_cache(maxsize=32)
+def _bank_fft(wavelet_name, scales, n, w, full_fft):
+    """(S, L) spectrum of the conj-reversed, group-delay-pre-rolled
+    wavelet bank (one-sided rfft when everything is real and
+    ``full_fft`` is False), plus (L, is_complex)."""
+    fn = _WAVELETS[wavelet_name]
+    kwargs = {"w": w} if wavelet_name == "morlet2" else {}
+    banks = [fn(int(min(10 * a, n)), a, **kwargs) for a in scales]
+    is_complex = any(np.iscomplexobj(b) for b in banks) or full_fft
+    max_len = max(b.shape[-1] for b in banks)
+    L = int(2 ** np.ceil(np.log2(n + max_len - 1)))
+    bank = np.zeros((len(banks), L), np.complex128)
+    for i, psi in enumerate(banks):
+        h = np.conj(psi)[::-1]
+        m = h.shape[-1]
+        # circular pre-roll by the 'same'-mode group delay: slot j of
+        # the circular conv then equals full-conv index j + (m-1)//2,
+        # so [:n] is the same-mode output for EVERY kernel length
+        s = (m - 1) // 2
+        bank[i, :m - s] = h[s:]
+        if s:
+            bank[i, L - s:] = h[:s]
+    if is_complex:
+        bank_f = np.fft.fft(bank, axis=-1).astype(np.complex64)
+    else:
+        # real wavelets keep the one-sided spectrum: rfft/irfft halves
+        # the FLOPs and the dominant (batch, S, L) workspace
+        bank_f = np.fft.rfft(bank.real, axis=-1).astype(np.complex64)
+    return jnp.asarray(bank_f), L, is_complex
+
+
+@functools.partial(jax.jit, static_argnames=("L", "n", "mode"))
+def _cwt_xla(x, bank_fft, L, n, mode):
+    """mode: 'real' (real signal+wavelet via rfft), 'complex' (either
+    side complex: full FFT, complex output)."""
+    if mode == "real":
+        xf = jnp.fft.rfft(x, n=L, axis=-1)
+        return jnp.fft.irfft(xf[..., None, :] * bank_fft, n=L,
+                             axis=-1)[..., :n].astype(jnp.float32)
+    xf = jnp.fft.fft(x.astype(jnp.complex64), n=L, axis=-1)
+    return jnp.fft.ifft(xf[..., None, :] * bank_fft, axis=-1)[..., :n]
+
+
+def cwt(x, scales, wavelet="ricker", *, w=5.0, impl=None):
+    """Continuous wavelet transform -> (..., n_scales, n): each scale
+    row is the 'same'-mode correlation of ``x`` with the scaled wavelet
+    (``wavelet`` in {"ricker", "morlet2"}; wavelet length
+    ``min(10*scale, n)`` — the scipy.signal.cwt contract). Output is
+    float32 for ricker, complex64 for morlet2 (take ``jnp.abs`` for the
+    scalogram). Leading axes of ``x`` are batch; the whole (batch,
+    scale) grid rides one FFT multiply."""
+    if wavelet not in _WAVELETS:
+        raise ValueError(f"wavelet must be one of {sorted(_WAVELETS)}, "
+                         f"got {wavelet!r}")
+    scales = tuple(float(a) for a in np.atleast_1d(scales))
+    if not scales or any(a <= 0 for a in scales):
+        raise ValueError("scales must be positive and non-empty")
+    if any(int(10 * a) < 1 for a in scales):
+        raise ValueError(
+            "scales below 0.1 floor the wavelet length min(10*a, n) "
+            "to zero samples; use scales >= 0.1")
+    n = np.shape(x)[-1]
+    if n == 0:
+        raise ValueError("x must be non-empty along the last axis")
+    x_complex = np.iscomplexobj(x)  # analytic/IQ input is supported
+    if resolve_impl(impl) == "reference":
+        fn = _WAVELETS[wavelet]
+        kwargs = {"w": w} if wavelet == "morlet2" else {}
+        xr = np.asarray(x, np.complex128 if x_complex else np.float64)
+        flat = xr.reshape(-1, n)
+        outs = [_ref.cwt(r, fn, scales, **kwargs) for r in flat]
+        return np.stack(outs).reshape(xr.shape[:-1] + (len(scales), n))
+    bank_fft, L, is_complex = _bank_fft(wavelet, scales, n, float(w),
+                                        x_complex)
+    xj = jnp.asarray(x, jnp.complex64 if x_complex else jnp.float32)
+    return _cwt_xla(xj, bank_fft, L, n,
+                    "complex" if is_complex else "real")

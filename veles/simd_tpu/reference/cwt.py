@@ -1,0 +1,57 @@
+"""Float64 oracle for the continuous wavelet transform (ops/cwt.py).
+
+Direct-convolution definition (the classic scipy.signal.cwt contract,
+kept alive here after scipy removed it in 1.15): for each scale ``a``,
+
+    out[a, t] = conv(x, conj(psi_a)[::-1], mode='same')
+
+with ``psi_a = wavelet(min(10*a, n), a)`` — i.e. a correlation of the
+signal with the scaled wavelet. Plain NumPy loops, float64/complex128.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ricker(points, a):
+    """Mexican-hat (Ricker) wavelet, scipy.signal.ricker's
+    normalization: A (1 - (t/a)^2) exp(-t^2 / (2 a^2)) with
+    A = 2 / (sqrt(3 a) pi^(1/4))."""
+    t = np.arange(points, dtype=np.float64) - (points - 1.0) / 2.0
+    A = 2.0 / (np.sqrt(3.0 * a) * np.pi ** 0.25)
+    tsq = (t / a) ** 2
+    return A * (1.0 - tsq) * np.exp(-tsq / 2.0)
+
+
+def morlet2(points, s, w=5.0):
+    """Complex Morlet wavelet, scipy.signal.morlet2's normalization:
+    pi^(-1/4) sqrt(1/s) exp(i w t/s) exp(-(t/s)^2 / 2)."""
+    t = (np.arange(points, dtype=np.float64)
+         - (points - 1.0) / 2.0) / s
+    return (np.pi ** -0.25 * np.sqrt(1.0 / s)
+            * np.exp(1j * w * t) * np.exp(-t * t / 2.0))
+
+
+def _wavelet_bank(wavelet, scales, n, **kwargs):
+    banks = []
+    for a in scales:
+        length = int(min(10 * a, n))
+        banks.append(wavelet(length, a, **kwargs))
+    return banks
+
+
+def cwt(x, wavelet, scales, **kwargs):
+    """(n_scales, n) CWT by direct same-mode correlation per scale."""
+    x = np.asarray(x, np.complex128 if np.iscomplexobj(x)
+                   else np.float64)
+    n = x.shape[-1]
+    banks = _wavelet_bank(wavelet, scales, n, **kwargs)
+    dtype = (np.complex128
+             if np.iscomplexobj(x)
+             or any(np.iscomplexobj(b) for b in banks)
+             else np.float64)
+    out = np.empty((len(scales), n), dtype)
+    for i, psi in enumerate(banks):
+        out[i] = np.convolve(x, np.conj(psi)[::-1], mode="same")
+    return out
