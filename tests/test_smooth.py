@@ -165,3 +165,38 @@ def test_wiener_large_dc_offset(rng):
     want = ref_smooth.wiener(x, 5)
     got = np.asarray(ops.wiener(x, 5))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-2)
+
+
+class TestMedfilt2d:
+    @pytest.mark.parametrize("k", [3, (3, 5), (5, 3)])
+    def test_differential(self, rng, k):
+        img = rng.normal(size=(20, 24)).astype(np.float32)
+        want = ref_smooth.medfilt2d(img, k if np.ndim(k) else (k, k))
+        got = np.asarray(ops.medfilt2d(img, k))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_batched_and_salt_pepper(self, rng):
+        img = rng.normal(size=(2, 16, 16)).astype(np.float32)
+        want = ref_smooth.medfilt2d(img, (3, 3))
+        got = np.asarray(ops.medfilt2d(img, 3))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        # defining property: isolated specks vanish
+        clean = np.zeros((12, 12), np.float32)
+        speck = clean.copy()
+        speck[6, 6] = 99.0
+        np.testing.assert_array_equal(
+            np.asarray(ops.medfilt2d(speck, 3)), clean)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError):
+            ops.medfilt2d(np.zeros((8, 8), np.float32), 4)
+        with pytest.raises(ValueError):
+            ops.medfilt2d(np.zeros(8, np.float32), 3)
+
+    def test_degenerate_shapes(self):
+        empty = np.zeros((4, 0), np.float32)
+        assert np.asarray(ops.medfilt2d(empty, 3)).shape == (4, 0)
+        zb = np.zeros((0, 8, 8), np.float32)
+        assert np.asarray(ops.medfilt2d(zb, 3)).shape == (0, 8, 8)
+        with pytest.raises(ValueError, match="H, W"):
+            ops.medfilt2d(np.zeros(8, np.float32), 3, impl="reference")

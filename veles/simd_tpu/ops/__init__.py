@@ -59,7 +59,7 @@ from veles.simd_tpu.ops.waveforms import (  # noqa: F401
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     firwin, resample, resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.smooth import (  # noqa: F401
-    medfilt, savgol_coeffs, savgol_filter, wiener)
+    medfilt, medfilt2d, savgol_coeffs, savgol_filter, wiener)
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     coherence, correlation_lags, csd, detrend, envelope, frame,
     get_window, hann_window, hilbert, istft, lombscargle, overlap_add,

@@ -4,11 +4,11 @@ Framework extensions along the scipy.signal axis (the reference C
 library has no smoother family). All reduce to TPU-friendly
 primitives:
 
-* ``medfilt`` — the gather-free framing view (``frame`` with hop 1)
-  turns the sliding window into a (..., n, k) tensor; the median is one
-  ``jnp.median`` over the trailing axis. Sorting k lanes per output
-  sample is the honest formulation on a vector unit — there is no
-  shift-add shortcut for order statistics.
+* ``medfilt`` / ``medfilt2d`` — the gather-free framing view (``frame``
+  with hop 1; kh shifted row-views in 2-D) turns the sliding window
+  into window lanes; the median is one ``jnp.median`` over the trailing
+  axis. Sorting k lanes per output sample is the honest formulation on
+  a vector unit — there is no shift-add shortcut for order statistics.
 * ``savgol_filter`` — the polynomial fit is linear in the samples, so
   the whole filter is one FIR correlation with host-designed
   coefficients (scipy.signal.savgol_coeffs, float64) plus an edge
@@ -26,6 +26,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from veles.simd_tpu.config import resolve_impl
 from veles.simd_tpu.ops.spectral import frame
@@ -60,6 +61,41 @@ def medfilt(x, kernel_size=3, *, impl=None):
     if x.shape[-1] < 1:
         return x
     return _medfilt_xla(x, kernel_size)
+
+
+@functools.partial(jax.jit, static_argnames=("kh", "kw"))
+def _medfilt2d_xla(x, kh, kw):
+    pad = [(0, 0)] * (x.ndim - 2) + [(kh // 2, kh // 2),
+                                     (kw // 2, kw // 2)]
+    xp = jnp.pad(x, pad)  # zero padding — scipy.signal.medfilt2d
+    h = x.shape[-2]
+    # kh shifted row-views, each framed along the column axis: the
+    # (kh*kw,) window lanes stack on a leading axis and one jnp.median
+    # reduces them — no gather, kh*kw static slices
+    views = [frame(xp[..., di:di + h, :], kw, 1) for di in range(kh)]
+    return jnp.median(jnp.concatenate(views, axis=-1), axis=-1)
+
+
+def medfilt2d(x, kernel_size=3, *, impl=None):
+    """2-D sliding-window median over the last two axes
+    (scipy.signal.medfilt2d semantics: odd kernel, zero-padded edges,
+    same shape); ``kernel_size`` is an int or (kh, kw) pair, leading
+    axes are batch. The salt-and-pepper rejector for image planes."""
+    if np.ndim(kernel_size) == 0:
+        kh = kw = int(kernel_size)
+    else:
+        kh, kw = (int(v) for v in kernel_size)
+    if kh < 1 or kw < 1 or kh % 2 == 0 or kw % 2 == 0:
+        raise ValueError(f"kernel sizes must be odd and >= 1, "
+                         f"got ({kh}, {kw})")
+    if np.ndim(x) < 2:  # before impl dispatch: same error on both legs
+        raise ValueError(f"need (..., H, W); got shape {np.shape(x)}")
+    if resolve_impl(impl) == "reference":
+        return _ref.medfilt2d(x, (kh, kw))
+    x = jnp.asarray(x, jnp.float32)
+    if kh == kw == 1 or 0 in x.shape[-2:] or 0 in x.shape[:-2]:
+        return x  # degenerate planes/batches pass through, like medfilt
+    return _medfilt2d_xla(x, kh, kw)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "estimate_noise"))

@@ -28,6 +28,15 @@ def savgol_filter(x, window_length, polyorder, deriv=0, delta=1.0,
                    deriv=deriv, delta=delta, axis=-1, mode=mode)
 
 
+def medfilt2d(x, kernel_size):
+    from scipy.signal import medfilt2d as _medfilt2d
+
+    x = np.asarray(x, np.float64)
+    flat = x.reshape((-1,) + x.shape[-2:])
+    out = np.stack([_medfilt2d(p, kernel_size) for p in flat])
+    return out.reshape(x.shape)
+
+
 def wiener(x, mysize=3, noise=None):
     from scipy.signal import wiener as _wiener
 
