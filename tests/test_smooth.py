@@ -200,3 +200,9 @@ class TestMedfilt2d:
         assert np.asarray(ops.medfilt2d(zb, 3)).shape == (0, 8, 8)
         with pytest.raises(ValueError, match="H, W"):
             ops.medfilt2d(np.zeros(8, np.float32), 3, impl="reference")
+
+    def test_degenerate_on_reference_leg(self):
+        empty = np.zeros((4, 0), np.float32)
+        assert ops.medfilt2d(empty, 3, impl="reference").shape == (4, 0)
+        zb = np.zeros((0, 8, 8), np.float32)
+        assert ops.medfilt2d(zb, 3, impl="reference").shape == (0, 8, 8)

@@ -28,6 +28,7 @@ MODULES = [
     "veles.simd_tpu.ops.cwt",
     "veles.simd_tpu.ops.czt",
     "veles.simd_tpu.ops.iir",
+    "veles.simd_tpu.ops.lti",
     "veles.simd_tpu.ops.normalize",
     "veles.simd_tpu.ops.resample",
     "veles.simd_tpu.ops.detect_peaks",

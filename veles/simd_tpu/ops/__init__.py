@@ -56,6 +56,8 @@ from veles.simd_tpu.ops.iir import (  # noqa: F401
     sosfilt_zi, sosfreqz, tf2sos, tf2zpk, zpk2sos, zpk2tf)
 from veles.simd_tpu.ops.waveforms import (  # noqa: F401
     chirp, gausspulse, sawtooth, square)
+from veles.simd_tpu.ops.lti import (  # noqa: F401
+    dimpulse, dlsim, dstep)
 from veles.simd_tpu.ops.resample import (  # noqa: F401
     firwin, resample, resample_filter, resample_poly, upfirdn)
 from veles.simd_tpu.ops.smooth import (  # noqa: F401

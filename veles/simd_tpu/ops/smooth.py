@@ -90,11 +90,14 @@ def medfilt2d(x, kernel_size=3, *, impl=None):
                          f"got ({kh}, {kw})")
     if np.ndim(x) < 2:  # before impl dispatch: same error on both legs
         raise ValueError(f"need (..., H, W); got shape {np.shape(x)}")
+    degenerate = kh == kw == 1 or 0 in np.shape(x)
     if resolve_impl(impl) == "reference":
+        if degenerate:  # pass through on BOTH legs (scipy would crash)
+            return np.asarray(x, np.float64)
         return _ref.medfilt2d(x, (kh, kw))
     x = jnp.asarray(x, jnp.float32)
-    if kh == kw == 1 or 0 in x.shape[-2:] or 0 in x.shape[:-2]:
-        return x  # degenerate planes/batches pass through, like medfilt
+    if degenerate:
+        return x
     return _medfilt2d_xla(x, kh, kw)
 
 
