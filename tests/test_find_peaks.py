@@ -168,3 +168,34 @@ def test_threshold_sweep_does_not_recompile(rng):
     for cut in (0.2, 0.3, 0.55):
         ops.find_peaks_fixed(x, prominence=cut, distance=3)
     assert _find_peaks_xla._cache_size() == before
+
+
+class TestArgrel:
+    @pytest.mark.parametrize("order", [1, 3, 10])
+    @pytest.mark.parametrize("mode", ["clip", "wrap"])
+    def test_matches_scipy(self, rng, order, mode):
+        from scipy.signal import argrelmax as sp_amax, argrelmin as sp_amin
+
+        x = rng.normal(size=300).astype(np.float32)
+        for ours, theirs in ((ops.argrelmax, sp_amax),
+                             (ops.argrelmin, sp_amin)):
+            pos, val, count, *_ = ours(x, order=order, mode=mode,
+                                       capacity=256)
+            c = int(count)
+            (want,) = theirs(x.astype(np.float64), order=order, mode=mode)
+            np.testing.assert_array_equal(np.asarray(pos)[:c], want)
+            np.testing.assert_allclose(np.asarray(val)[:c], x[want],
+                                       rtol=1e-6)
+
+    def test_batched_and_reference(self, rng):
+        x = rng.normal(size=(3, 100)).astype(np.float32)
+        pos, val, count = ops.argrelmax(x, order=2, capacity=64)
+        assert pos.shape == (3, 64) and count.shape == (3,)
+        ref = ops.argrelmax(x[0], order=2, capacity=64, impl="reference")
+        np.testing.assert_array_equal(np.asarray(pos[0]), ref[0])
+
+    def test_contracts(self, rng):
+        with pytest.raises(ValueError):
+            ops.argrelmax(np.zeros(8, np.float32), order=0)
+        with pytest.raises(ValueError):
+            ops.argrelmax(np.zeros(8, np.float32), mode="reflect")

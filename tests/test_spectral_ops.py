@@ -385,3 +385,34 @@ def test_window_and_lag_passthroughs():
         ss.get_window(("kaiser", 8.0), 64))
     np.testing.assert_array_equal(ops.correlation_lags(100, 30),
                                   ss.correlation_lags(100, 30))
+
+
+class TestVectorstrength:
+    def test_matches_scipy(self, rng):
+        import scipy.signal as ss
+
+        events = np.sort(rng.uniform(0, 100, 200))
+        for period in (3.7, [1.0, 3.7, 10.0]):
+            ws, wp = ss.vectorstrength(events, period)
+            gs, gp = ops.vectorstrength(events, period)
+            np.testing.assert_allclose(np.asarray(gs), ws, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(gp), wp, atol=1e-4)
+
+    def test_perfect_and_uniform_locking(self, rng):
+        locked = np.arange(50) * 2.5  # every event at phase 0 of T=2.5
+        s, p = ops.vectorstrength(locked.astype(np.float32), 2.5)
+        assert float(s) > 0.999 and abs(float(p)) < 1e-2
+        uniform = rng.uniform(0, 1000, 5000)
+        s2, _ = ops.vectorstrength(uniform.astype(np.float32), 7.0)
+        assert float(s2) < 0.05
+
+    def test_large_timestamps_stay_accurate(self):
+        """Raw event times ~1e7 s: f64 host-side phase reduction keeps
+        the statistic exact where naive f32 angles are garbage."""
+        import scipy.signal as ss
+
+        events = 1e7 + np.arange(80) * 2.5  # perfectly locked, T=2.5
+        gs, gp = ops.vectorstrength(events, 2.5)
+        ws, wp = ss.vectorstrength(events, 2.5)
+        np.testing.assert_allclose(float(gs), ws, atol=1e-4)
+        assert float(gs) > 0.999

@@ -45,7 +45,8 @@ from veles.simd_tpu.ops.cwt import (  # noqa: F401
     cwt, morlet2, ricker)
 from veles.simd_tpu.ops.czt import czt, zoom_fft  # noqa: F401
 from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
-    find_peaks_fixed, peak_prominences, peak_widths)
+    argrelmax, argrelmin, find_peaks_fixed, peak_prominences,
+    peak_widths)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, bessel, bilinear, butter_sos, buttord, cheb1ord,
     cheb2ord, cheby1_sos, cheby2, decimate, deconvolve, ellip, ellipord,
@@ -65,7 +66,7 @@ from veles.simd_tpu.ops.smooth import (  # noqa: F401
 from veles.simd_tpu.ops.spectral import (  # noqa: F401
     coherence, correlation_lags, csd, detrend, envelope, frame,
     get_window, hann_window, hilbert, istft, lombscargle, overlap_add,
-    periodogram, spectrogram, stft, welch)
+    periodogram, spectrogram, stft, vectorstrength, welch)
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,

@@ -344,6 +344,69 @@ def _ref_padded(x, peaks, fn, fills):
     return tuple(out)
 
 
+@functools.partial(jax.jit, static_argnames=("order", "mode", "capacity",
+                                             "comparator"))
+def _argrel_xla(x, order, mode, capacity, comparator):
+    n = x.shape[-1]
+    if mode == "clip":
+        pad_kw = {"mode": "edge"}
+    else:  # "wrap"
+        pad_kw = {"mode": "wrap"}
+    xp = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(order, order)], **pad_kw)
+    sel = jnp.ones(x.shape, bool)
+    for k in range(1, order + 1):
+        left = xp[..., order - k:order - k + n]
+        right = xp[..., order + k:order + k + n]
+        if comparator == "greater":
+            sel &= (x > left) & (x > right)
+        else:
+            sel &= (x < left) & (x < right)
+    return _compact_mask(sel, x, capacity)
+
+
+def argrelmax(x, *, order=1, mode="clip", capacity=64, impl=None):
+    """Relative maxima strictly greater than ALL neighbors within
+    ``order`` samples on both sides -> (positions, values, count) at
+    fixed ``capacity`` (scipy.signal.argrelmax semantics; ``mode`` in
+    {"clip", "wrap"} is scipy's boundary treatment). 1-D or batched
+    leading axes (positions are per-row)."""
+    return _argrel(x, order, mode, capacity, impl, "greater")
+
+
+def argrelmin(x, *, order=1, mode="clip", capacity=64, impl=None):
+    """Relative minima twin of :func:`argrelmax`."""
+    return _argrel(x, order, mode, capacity, impl, "less")
+
+
+def _argrel(x, order, mode, capacity, impl, comparator):
+    order = int(order)
+    if order < 1:
+        raise ValueError("order must be >= 1")
+    if mode not in ("clip", "wrap"):
+        raise ValueError(f"mode must be 'clip' or 'wrap', got {mode!r}")
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import argrelmax as _amax, argrelmin as _amin
+        fn = _amax if comparator == "greater" else _amin
+        x64 = np.asarray(x, np.float64)
+        if x64.ndim != 1:
+            raise ValueError("reference impl is 1-D")
+        (pos,) = fn(x64, order=order, mode=mode)
+        count = min(len(pos), capacity)
+        positions = np.full(capacity, -1, np.int32)
+        values = np.zeros(capacity, np.float32)
+        positions[:count] = pos[:count]
+        values[:count] = x64[pos[:count]]
+        return positions, values, np.int32(count)
+    x = jnp.asarray(x, jnp.float32)
+    cap = min(int(capacity), x.shape[-1])
+    pos, val, count = _argrel_xla(x, order, mode, cap, comparator)
+    if cap < capacity:
+        pad = [(0, 0)] * (pos.ndim - 1) + [(0, capacity - cap)]
+        pos = jnp.pad(pos, pad, constant_values=-1)
+        val = jnp.pad(val, pad)
+    return pos, val, count
+
+
 def peak_prominences(x, peaks, *, impl=None):
     """Prominence of each given peak index -> (prominences, left_bases,
     right_bases), shapes matching ``peaks`` (scipy.signal

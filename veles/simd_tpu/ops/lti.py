@@ -128,30 +128,30 @@ def dlsim(system, u, x0=None, *, impl=None):
                       jnp.asarray(D, jnp.float32), u, x0j, _CHUNK)
 
 
+def _per_input_response(system, n, impl, step):
+    """One dlsim per input channel (step or impulse on that channel);
+    the (A, B, C, D) normalization lives in dlsim — single home."""
+    n_in = np.atleast_2d(np.asarray(system[1])).shape[1]
+    outs = []
+    for j in range(n_in):
+        u = np.zeros((n, n_in), np.float32)
+        if step:
+            u[:, j] = 1.0
+        else:
+            u[0, j] = 1.0
+        y, _ = dlsim(system, u, impl=impl)
+        outs.append(np.asarray(y))
+    return tuple(outs)
+
+
 def dstep(system, n=100, *, impl=None):
     """Unit-step response -> tuple of (n, n_out) arrays, one per input
     channel, like scipy.signal.dstep (one simulation per input, step on
     that input)."""
-    A, B, C, D = (np.atleast_2d(np.asarray(m, np.float64))
-                  for m in system)
-    outs = []
-    for j in range(B.shape[1]):
-        u = np.zeros((n, B.shape[1]), np.float32)
-        u[:, j] = 1.0
-        y, _ = dlsim((A, B, C, D), u, impl=impl)
-        outs.append(np.asarray(y))
-    return tuple(outs)
+    return _per_input_response(system, n, impl, step=True)
 
 
 def dimpulse(system, n=100, *, impl=None):
-    """Unit-impulse response -> tuple of (..., n, n_out) per input
-    channel, like scipy.signal.dimpulse."""
-    A, B, C, D = (np.atleast_2d(np.asarray(m, np.float64))
-                  for m in system)
-    outs = []
-    for j in range(B.shape[1]):
-        u = np.zeros((n, B.shape[1]), np.float32)
-        u[0, j] = 1.0
-        y, _ = dlsim((A, B, C, D), u, impl=impl)
-        outs.append(np.asarray(y))
-    return tuple(outs)
+    """Unit-impulse response -> tuple of (n, n_out) arrays, one per
+    input channel, like scipy.signal.dimpulse."""
+    return _per_input_response(system, n, impl, step=False)

@@ -406,6 +406,40 @@ def _lombscargle_args(t, y, freqs, weights):
     return t, y, freqs, w
 
 
+def vectorstrength(events, period, *, impl=None):
+    """Phase-locking of event times to one or more periods ->
+    (strength, phase), scipy.signal.vectorstrength semantics: each
+    event maps to a unit phasor exp(2*pi*i*t/T); strength is the mean
+    phasor's magnitude (1 = perfect locking, ~0 = uniform), phase its
+    angle. ``period`` may be scalar or a 1-D array (vectorized across
+    periods — one broadcast trig pass)."""
+    if resolve_impl(impl) == "reference":
+        from scipy.signal import vectorstrength as _vs
+        return _vs(np.asarray(events, np.float64), period)
+    if np.ndim(events) != 1 or np.shape(events)[-1] == 0:
+        raise ValueError("events must be non-empty 1-D")
+    scalar = np.ndim(period) == 0
+    try:
+        # concrete inputs: reduce phases host-side in float64 (the czt
+        # chirp pattern) — raw timestamps like 1e7 s lose ~radians of
+        # phase in f32, silently corrupting the statistic
+        ev64 = np.asarray(events, np.float64)
+        per64 = np.atleast_1d(np.asarray(period, np.float64))
+        frac = np.mod(ev64[None, :] / per64[:, None], 1.0)
+        ang = jnp.asarray(2 * np.pi * frac, jnp.float32)
+    except Exception:  # traced inputs: in-graph f32 (small-|t| use)
+        events = jnp.asarray(events, jnp.float32)
+        period_arr = jnp.atleast_1d(jnp.asarray(period, jnp.float32))
+        ang = 2 * jnp.pi * events[None, :] / period_arr[:, None]
+    re = jnp.mean(jnp.cos(ang), axis=-1)
+    im = jnp.mean(jnp.sin(ang), axis=-1)
+    strength = jnp.sqrt(re * re + im * im)
+    phase = jnp.arctan2(im, re)
+    if scalar:
+        return strength[0], phase[0]
+    return strength, phase
+
+
 @jax.jit
 def _hilbert_xla(x):
     x = jnp.asarray(x, jnp.float32)
