@@ -416,3 +416,10 @@ class TestVectorstrength:
         ws, wp = ss.vectorstrength(events, 2.5)
         np.testing.assert_allclose(float(gs), ws, atol=1e-4)
         assert float(gs) > 0.999
+
+    def test_period_validation(self, rng):
+        events = rng.uniform(0, 10, 20)
+        with pytest.raises(ValueError, match="positive"):
+            ops.vectorstrength(events, 0.0)
+        with pytest.raises(ValueError, match="positive"):
+            ops.vectorstrength(events, [2.0, -3.0])

@@ -173,21 +173,78 @@ def sosfilt(x, sos, *, impl=None, chunk=None):
     return y
 
 
-def sosfiltfilt(x, sos, *, impl=None):
+def _odd_ext(x, padlen):
+    """Odd extension about both endpoints (scipy's filtfilt default):
+    point-reflect the first/last ``padlen`` samples through the edge
+    values."""
+    left = 2.0 * x[..., :1] - x[..., padlen:0:-1]
+    right = 2.0 * x[..., -1:] - x[..., -2:-padlen - 2:-1]
+    return jnp.concatenate([left, x, right], axis=-1)
+
+
+def sosfiltfilt(x, sos, *, padtype=None, padlen=None, impl=None,
+                chunk=None):
     """Zero-phase filtering: forward pass, reverse, forward pass,
     reverse — squares the magnitude response and cancels the phase.
 
-    Simpler contract than scipy.signal.sosfiltfilt: no edge padding or
-    initial-condition matching, so the two agree away from the ends but
-    differ in the first/last transient spans (document-by-construction;
-    pad the signal if edges matter). Leading axes are batch.
+    ``padtype=None`` (default) is the simple contract: no edge padding
+    or initial-condition matching, so scipy and this op agree away from
+    the ends but differ in the first/last transient spans.
+    ``padtype="odd"`` reproduces scipy.signal.sosfiltfilt EXACTLY
+    (including the edges): odd-extend by ``padlen`` (scipy's default
+    ``3 * (2 * n_sections + 1 - min(#fir-like zeros))`` when None),
+    start each pass at the steady state of its first sample
+    (sosfilt_zi), and slice the extension back off. Leading axes are
+    batch.
     """
     # pass the RESOLVED impl through: the inner calls must never
     # re-resolve the ambient setting over an explicit impl= (the
     # jitted-caller pinning convention)
     impl = resolve_impl(impl)
-    fwd = sosfilt(x, sos, impl=impl)
-    return sosfilt(fwd[..., ::-1], sos, impl=impl)[..., ::-1]
+    if padtype is None:
+        if impl == "reference":
+            fwd = _ref.sosfilt(x, sos)
+            return _ref.sosfilt(fwd[..., ::-1], sos)[..., ::-1]
+        fwd = sosfilt(x, sos, impl=impl, chunk=chunk)
+        return sosfilt(fwd[..., ::-1], sos, impl=impl,
+                       chunk=chunk)[..., ::-1]
+    if padtype != "odd":
+        raise ValueError(f"padtype must be None or 'odd', got "
+                         f"{padtype!r}")
+    sos64 = _ref._check_sos(sos)
+    if padlen is None:
+        # scipy's default pad length for the sos form: 3 * ntaps with
+        # ntaps reduced by shared trailing-zero tap rows
+        n_sections = sos64.shape[0]
+        ntaps = 2 * n_sections + 1 - min(
+            int((sos64[:, 2] == 0).sum()), int((sos64[:, 5] == 0).sum()))
+        padlen = 3 * ntaps
+    padlen = int(padlen)
+    if impl == "reference":
+        from scipy.signal import sosfiltfilt as _sff
+        return _sff(sos64, np.asarray(x, np.float64), axis=-1,
+                    padtype="odd", padlen=padlen)
+    x = jnp.asarray(x, jnp.float32)
+    if padlen >= x.shape[-1]:
+        raise ValueError(
+            f"padlen ({padlen}) must be less than the signal length "
+            f"({x.shape[-1]})")
+    from scipy.signal import sosfilt_zi as _zi
+    zi = jnp.asarray(_zi(sos64), jnp.float32)  # (n_sections, 2)
+    ext = _odd_ext(x, padlen) if padlen > 0 else x
+    cs = _chunk_policy(ext.shape[-1], chunk)
+    sosj = jnp.asarray(sos64, jnp.float32)
+
+    def one_pass(sig):
+        s0 = zi * sig[..., :1, None]  # steady state of the first sample
+        y, _ = _sosfilt_xla(sig, sosj, s0, sos64.shape[0], chunk=cs)
+        return y
+
+    y = one_pass(ext)
+    y = one_pass(y[..., ::-1])[..., ::-1]
+    if padlen > 0:
+        y = y[..., padlen:-padlen]
+    return y
 
 
 def butter_sos(order, wn, btype="lowpass"):
@@ -327,12 +384,12 @@ def decimate(x, q, *, order=8, rp=0.05, zero_phase=True, impl=None):
     scipy.signal.decimate's default path (order-8 Chebyshev type I,
     0.05 dB ripple, cutoff 0.8/q), data axis last.
 
-    ``zero_phase=True`` runs :func:`sosfiltfilt`, which here pads
-    nothing (see its docstring): interior samples match scipy, the
-    first/last transient spans differ. For FIR anti-aliasing use
-    ``ops.resample_poly(x, 1, q)`` — that is scipy's ftype="fir" path
-    with a polyphase schedule that never computes the discarded
-    samples.
+    ``zero_phase=True`` runs :func:`sosfiltfilt` with scipy's exact
+    odd-extension edge handling, so the output matches
+    scipy.signal.decimate everywhere including the ends. For FIR
+    anti-aliasing use ``ops.resample_poly(x, 1, q)`` — that is scipy's
+    ftype="fir" path with a polyphase schedule that never computes the
+    discarded samples.
     """
     q = int(q)
     if q < 1:
@@ -353,7 +410,7 @@ def decimate(x, q, *, order=8, rp=0.05, zero_phase=True, impl=None):
     if q == 1:
         return x
     sos = cheby1_sos(order, rp, 0.8 / q)
-    y = (sosfiltfilt(x, sos, impl=impl) if zero_phase
+    y = (sosfiltfilt(x, sos, padtype="odd", impl=impl) if zero_phase
          else sosfilt(x, sos, impl=impl))
     return y[..., ::q]
 
@@ -374,12 +431,17 @@ def _sosfreqz_f64(sos64, n_freqs):
     return w, np.prod(num / den, axis=0)
 
 
-def filtfilt(b, a, x, *, impl=None, chunk=None):
-    """Zero-phase (b, a) filtering: :func:`lfilter` forward, reverse,
-    forward, reverse — the tf-coefficient twin of :func:`sosfiltfilt`,
-    with the same simplified contract (no edge padding or
-    initial-condition matching; the two ends carry transients)."""
+def filtfilt(b, a, x, *, padtype=None, padlen=None, impl=None,
+             chunk=None):
+    """Zero-phase (b, a) filtering — the tf-coefficient twin of
+    :func:`sosfiltfilt`: ``padtype=None`` is the simple no-padding
+    contract (ends carry transients); ``padtype="odd"`` routes through
+    the cascade form with scipy's exact odd-extension + steady-state
+    edge handling."""
     impl = resolve_impl(impl)
+    if padtype is not None:
+        return sosfiltfilt(x, tf2sos(b, a), padtype=padtype,
+                           padlen=padlen, impl=impl, chunk=chunk)
     fwd = lfilter(b, a, x, impl=impl, chunk=chunk)
     return lfilter(b, a, fwd[..., ::-1], impl=impl,
                    chunk=chunk)[..., ::-1]

@@ -419,18 +419,32 @@ def vectorstrength(events, period, *, impl=None):
     if np.ndim(events) != 1 or np.shape(events)[-1] == 0:
         raise ValueError("events must be non-empty 1-D")
     scalar = np.ndim(period) == 0
-    try:
+
+    def host64(a):
+        """np.float64 view of a concrete value, None for tracers —
+        ONLY tracer errors reroute; real failures must surface."""
+        try:
+            return np.atleast_1d(np.asarray(a, np.float64))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            return None
+
+    per64 = host64(period)
+    if per64 is not None and np.any(per64 <= 0):
+        raise ValueError("periods must be positive")  # scipy's rule
+    ev64 = host64(events)
+    if per64 is not None and ev64 is not None:
         # concrete inputs: reduce phases host-side in float64 (the czt
         # chirp pattern) — raw timestamps like 1e7 s lose ~radians of
         # phase in f32, silently corrupting the statistic
-        ev64 = np.asarray(events, np.float64)
-        per64 = np.atleast_1d(np.asarray(period, np.float64))
         frac = np.mod(ev64[None, :] / per64[:, None], 1.0)
         ang = jnp.asarray(2 * np.pi * frac, jnp.float32)
-    except Exception:  # traced inputs: in-graph f32 (small-|t| use)
-        events = jnp.asarray(events, jnp.float32)
+    else:
+        # traced inputs only: in-graph f32 (fine for small |t|; large
+        # traced timestamps should be pre-centered by the caller)
+        eventsj = jnp.asarray(events, jnp.float32)
         period_arr = jnp.atleast_1d(jnp.asarray(period, jnp.float32))
-        ang = 2 * jnp.pi * events[None, :] / period_arr[:, None]
+        ang = 2 * jnp.pi * eventsj[None, :] / period_arr[:, None]
     re = jnp.mean(jnp.cos(ang), axis=-1)
     im = jnp.mean(jnp.sin(ang), axis=-1)
     strength = jnp.sqrt(re * re + im * im)
