@@ -202,9 +202,6 @@ def sosfiltfilt(x, sos, *, padtype=None, padlen=None, impl=None,
     # jitted-caller pinning convention)
     impl = resolve_impl(impl)
     if padtype is None:
-        if impl == "reference":
-            fwd = _ref.sosfilt(x, sos)
-            return _ref.sosfilt(fwd[..., ::-1], sos)[..., ::-1]
         fwd = sosfilt(x, sos, impl=impl, chunk=chunk)
         return sosfilt(fwd[..., ::-1], sos, impl=impl,
                        chunk=chunk)[..., ::-1]
@@ -229,8 +226,7 @@ def sosfiltfilt(x, sos, *, padtype=None, padlen=None, impl=None,
         raise ValueError(
             f"padlen ({padlen}) must be less than the signal length "
             f"({x.shape[-1]})")
-    from scipy.signal import sosfilt_zi as _zi
-    zi = jnp.asarray(_zi(sos64), jnp.float32)  # (n_sections, 2)
+    zi = jnp.asarray(sosfilt_zi(sos64), jnp.float32)  # (n_sections, 2)
     ext = _odd_ext(x, padlen) if padlen > 0 else x
     cs = _chunk_policy(ext.shape[-1], chunk)
     sosj = jnp.asarray(sos64, jnp.float32)
