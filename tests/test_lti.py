@@ -94,3 +94,32 @@ class TestStepImpulse:
                            + (1.0,), n=50)[1]
         for g, w_ in zip(got, want):
             np.testing.assert_allclose(g, w_, rtol=1e-3, atol=1e-4)
+
+
+def test_cont2discrete_to_dlsim_loop(rng):
+    """The analog->digital->simulate loop: discretize a continuous
+    system and verify dlsim's step response approaches the continuous
+    DC gain -C A^-1 B + D."""
+    import scipy.signal as ss
+
+    A = np.array([[-1.0, 0.5], [0.0, -2.0]])
+    B = np.array([[1.0], [1.0]])
+    C = np.array([[1.0, 0.0]])
+    D = np.array([[0.0]])
+    Ad, Bd, Cd, Dd, _ = ops.cont2discrete((A, B, C, D), dt=0.05)
+    want = ss.cont2discrete((A, B, C, D), dt=0.05)
+    np.testing.assert_allclose(Ad, want[0], atol=1e-12)
+    (y,) = ops.dstep((Ad, Bd, Cd, Dd), n=400)
+    dc_cont = (-C @ np.linalg.solve(A, B) + D).ravel()
+    np.testing.assert_allclose(y[-1], dc_cont, rtol=1e-2, atol=1e-3)
+
+
+def test_analog_passthroughs_match_scipy():
+    import scipy.signal as ss
+
+    b, a = ss.butter(3, 1.0, analog=True)
+    np.testing.assert_array_equal(ops.lp2hp(b, a, 2.0)[0],
+                                  ss.lp2hp(b, a, 2.0)[0])
+    w, h = ops.freqs(b, a, worN=64)
+    ww, wh = ss.freqs(b, a, worN=64)
+    np.testing.assert_allclose(h, wh, rtol=1e-12)

@@ -345,3 +345,38 @@ class TestLombscargleSharded:
             parallel.lombscargle_sharded(
                 t, y, np.linspace(0.1, 1, 64), mesh=m,
                 weights=np.ones(49))
+
+
+class TestCwtSharded:
+    def test_matches_single_device(self, rng):
+        m = parallel.make_mesh({"scale": 8})
+        x = rng.normal(size=512).astype(np.float32)
+        scales = tuple(np.geomspace(2, 40, 16))
+        want = np.asarray(ops.cwt(x, scales, "morlet2"))
+        got = np.asarray(parallel.cwt_sharded(x, scales, "morlet2",
+                                              mesh=m))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_batched_ricker_and_contract(self, rng):
+        m = parallel.make_mesh({"scale": 4})
+        x = rng.normal(size=(2, 256)).astype(np.float32)
+        scales = tuple(np.geomspace(2, 20, 8))
+        want = np.asarray(ops.cwt(x, scales))
+        got = np.asarray(parallel.cwt_sharded(x, scales, mesh=m))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        with pytest.raises(ValueError, match="divide"):
+            parallel.cwt_sharded(x, scales[:-1], mesh=m)
+
+    def test_complex_input_and_tiny_scale(self, rng):
+        """Analytic input keeps its imaginary part on the sharded path
+        too; degenerate scales raise cwt's clear error (review r3)."""
+        m = parallel.make_mesh({"scale": 4})
+        x = rng.normal(size=256).astype(np.float32)
+        xa = np.asarray(ops.hilbert(x))
+        scales = tuple(np.geomspace(3, 20, 8))
+        got = np.asarray(parallel.cwt_sharded(xa, scales, mesh=m))
+        want = np.asarray(ops.cwt(xa, scales))
+        assert got.dtype == np.complex64
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        with pytest.raises(ValueError, match="0.1"):
+            parallel.cwt_sharded(x, (0.05,) * 4, mesh=m)

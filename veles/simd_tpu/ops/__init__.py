@@ -49,10 +49,11 @@ from veles.simd_tpu.ops.find_peaks import (  # noqa: F401
     peak_widths)
 from veles.simd_tpu.ops.iir import (  # noqa: F401
     IirStreamState, bessel, bilinear, butter_sos, buttord, cheb1ord,
-    cheb2ord, cheby1_sos, cheby2, decimate, deconvolve, ellip, ellipord,
-    filtfilt, firls, firwin2, freqz, group_delay, iircomb, iirdesign,
-    iirfilter, iirnotch, iirpeak, iir_stream_init, iir_stream_step,
-    kaiser_atten, kaiser_beta, kaiserord, lfilter, lfilter_zi,
+    cheb2ord, cheby1_sos, cheby2, cont2discrete, decimate, deconvolve,
+    ellip, ellipord, filtfilt, firls, firwin2, freqs, freqs_zpk, freqz,
+    group_delay, iircomb, iirdesign, iirfilter, iirnotch, iirpeak,
+    iir_stream_init, iir_stream_step, kaiser_atten, kaiser_beta,
+    kaiserord, lfilter, lfilter_zi, lp2bp, lp2bs, lp2hp, lp2lp,
     minimum_phase, remez, sos2tf, sos2zpk, sosfilt, sosfiltfilt,
     sosfilt_zi, sosfreqz, tf2sos, tf2zpk, zpk2sos, zpk2tf)
 from veles.simd_tpu.ops.waveforms import (  # noqa: F401

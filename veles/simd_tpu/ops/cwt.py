@@ -88,14 +88,11 @@ def _cwt_xla(x, bank_fft, L, n, mode):
     return jnp.fft.ifft(xf[..., None, :] * bank_fft, axis=-1)[..., :n]
 
 
-def cwt(x, scales, wavelet="ricker", *, w=5.0, impl=None):
-    """Continuous wavelet transform -> (..., n_scales, n): each scale
-    row is the 'same'-mode correlation of ``x`` with the scaled wavelet
-    (``wavelet`` in {"ricker", "morlet2"}; wavelet length
-    ``min(10*scale, n)`` — the scipy.signal.cwt contract). Output is
-    float32 for ricker, complex64 for morlet2 (take ``jnp.abs`` for the
-    scalogram). Leading axes of ``x`` are batch; the whole (batch,
-    scale) grid rides one FFT multiply."""
+def _cwt_args(x, scales, wavelet):
+    """Shared validation for cwt and parallel.cwt_sharded: normalize
+    scales, reject degenerate ones, and detect complex input BEFORE any
+    cast (a float32 cast silently drops the imaginary part). Returns
+    (scales tuple, n, x_complex)."""
     if wavelet not in _WAVELETS:
         raise ValueError(f"wavelet must be one of {sorted(_WAVELETS)}, "
                          f"got {wavelet!r}")
@@ -109,7 +106,18 @@ def cwt(x, scales, wavelet="ricker", *, w=5.0, impl=None):
     n = np.shape(x)[-1]
     if n == 0:
         raise ValueError("x must be non-empty along the last axis")
-    x_complex = np.iscomplexobj(x)  # analytic/IQ input is supported
+    return scales, n, np.iscomplexobj(x)
+
+
+def cwt(x, scales, wavelet="ricker", *, w=5.0, impl=None):
+    """Continuous wavelet transform -> (..., n_scales, n): each scale
+    row is the 'same'-mode correlation of ``x`` with the scaled wavelet
+    (``wavelet`` in {"ricker", "morlet2"}; wavelet length
+    ``min(10*scale, n)`` — the scipy.signal.cwt contract). Output is
+    float32 for ricker, complex64 for morlet2 (take ``jnp.abs`` for the
+    scalogram). Leading axes of ``x`` are batch; the whole (batch,
+    scale) grid rides one FFT multiply."""
+    scales, n, x_complex = _cwt_args(x, scales, wavelet)
     if resolve_impl(impl) == "reference":
         fn = _WAVELETS[wavelet]
         kwargs = {"w": w} if wavelet == "morlet2" else {}

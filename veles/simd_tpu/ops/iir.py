@@ -321,6 +321,20 @@ kaiserord = _design_passthrough(
     "kaiserord", "Kaiser estimator; returns (numtaps, beta) for firwin.")
 kaiser_beta = _design_passthrough("kaiser_beta", _USE_PARAM)
 kaiser_atten = _design_passthrough("kaiser_atten", _USE_PARAM)
+_USE_ANALOG = ("analog-prototype transformation; feed the result "
+               "through bilinear/cont2discrete to reach the discrete "
+               "ops.")
+lp2lp = _design_passthrough("lp2lp", _USE_ANALOG)
+lp2hp = _design_passthrough("lp2hp", _USE_ANALOG)
+lp2bp = _design_passthrough("lp2bp", _USE_ANALOG)
+lp2bs = _design_passthrough("lp2bs", _USE_ANALOG)
+freqs = _design_passthrough(
+    "freqs", "analog (s-plane) frequency response; returns (w, H).")
+freqs_zpk = _design_passthrough(
+    "freqs_zpk", "analog zpk frequency response; returns (w, H).")
+cont2discrete = _design_passthrough(
+    "cont2discrete", "continuous -> discrete state-space conversion; "
+    "feed the (A, B, C, D) result to dlsim/dstep/dimpulse.")
 
 
 def sosfilt_zi(sos):

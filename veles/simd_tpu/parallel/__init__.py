@@ -41,7 +41,8 @@ from veles.simd_tpu.parallel.experts import (  # noqa: F401
 from veles.simd_tpu.parallel.overlap_save import (  # noqa: F401
     convolve_overlap_save_sharded, overlap_save_map)
 from veles.simd_tpu.parallel.ops import (  # noqa: F401
-    batch_map, convolve_sharded, detect_peaks_fixed_sharded,
+    batch_map, convolve_sharded, cwt_sharded,
+    detect_peaks_fixed_sharded,
     lombscargle_sharded, minmax1D_sharded, normalize1D_sharded,
     sosfilt_sharded, stationary_wavelet_apply_sharded,
     stationary_wavelet_decompose_sharded, wavelet_apply_sharded,

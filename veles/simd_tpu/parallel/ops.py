@@ -327,3 +327,37 @@ def lombscargle_sharded(t, y, freqs, *, mesh, axis="freq", weights=None,
                    in_specs=(P(), P(), P(), P(axis)),
                    out_specs=P(axis))
     return fn(t, y, w, freqs)
+
+
+def cwt_sharded(x, scales, wavelet="ricker", *, mesh, axis="scale",
+                w=5.0):
+    """Continuous wavelet transform with the SCALE axis sharded over
+    the mesh -> (..., n_scales, n), sharded along ``axis``.
+
+    Scales are embarrassingly parallel (the lombscargle_sharded
+    pattern): the signal replicates, each device transforms its scale
+    slice with zero collectives, and the dominant (batch, S, L) FFT
+    workspace divides by the mesh size. The wavelet-bank FFT is
+    precomputed host-side once and sharded with the scale axis.
+    """
+    from veles.simd_tpu.ops.cwt import _bank_fft, _cwt_args, _cwt_xla
+
+    scales, n, x_complex = _cwt_args(x, scales, wavelet)
+    n_shards = mesh.shape[axis]
+    if len(scales) % n_shards:
+        raise ValueError(
+            f"len(scales) ({len(scales)}) must divide the {axis!r} "
+            f"mesh axis ({n_shards}); pad the scale grid")
+    x = jnp.asarray(x, jnp.complex64 if x_complex else jnp.float32)
+    bank_fft, L, is_complex = _bank_fft(wavelet, scales, n, float(w),
+                                        x_complex)
+
+    def local(x_rep, bank_loc):
+        return _cwt_xla(x_rep, bank_loc, L, n,
+                        "complex" if is_complex else "real")
+
+    nb = x.ndim - 1  # batch dims of x: replicated
+    out_spec = P(*([None] * nb), axis, None)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(axis, None)), out_specs=out_spec)
+    return fn(x, bank_fft)
