@@ -297,6 +297,17 @@ def detect_peaks_fixed_sharded(data, extremum_type=None, *, capacity, mesh,
     return fn(jnp.asarray(data, jnp.float32))
 
 
+def _check_axis_divides(n_items, mesh, axis, what):
+    """Shared guard for embarrassingly-parallel grids (freq, scale):
+    shard_map's generic divisibility error names spec machinery, not
+    the op."""
+    n_shards = mesh.shape[axis]
+    if n_items % n_shards:
+        raise ValueError(
+            f"{what} length ({n_items}) must divide the {axis!r} "
+            f"mesh axis ({n_shards}); pad the {what} grid")
+
+
 def lombscargle_sharded(t, y, freqs, *, mesh, axis="freq", weights=None,
                         floating_mean=False):
     """Lomb-Scargle periodogram with the FREQUENCY axis sharded over the
@@ -313,11 +324,7 @@ def lombscargle_sharded(t, y, freqs, *, mesh, axis="freq", weights=None,
                                              _lombscargle_xla)
 
     t, y, freqs, w = _lombscargle_args(t, y, freqs, weights)
-    n_shards = mesh.shape[axis]
-    if freqs.shape[-1] % n_shards:
-        raise ValueError(
-            f"len(freqs) ({freqs.shape[-1]}) must divide the {axis!r} "
-            f"mesh axis ({n_shards}); pad the frequency grid")
+    _check_axis_divides(freqs.shape[-1], mesh, axis, "frequency")
 
     def local(t_rep, y_rep, w_rep, freqs_loc):
         return _lombscargle_xla(t_rep, y_rep, freqs_loc, w_rep,
@@ -343,11 +350,7 @@ def cwt_sharded(x, scales, wavelet="ricker", *, mesh, axis="scale",
     from veles.simd_tpu.ops.cwt import _bank_fft, _cwt_args, _cwt_xla
 
     scales, n, x_complex = _cwt_args(x, scales, wavelet)
-    n_shards = mesh.shape[axis]
-    if len(scales) % n_shards:
-        raise ValueError(
-            f"len(scales) ({len(scales)}) must divide the {axis!r} "
-            f"mesh axis ({n_shards}); pad the scale grid")
+    _check_axis_divides(len(scales), mesh, axis, "scale")
     x = jnp.asarray(x, jnp.complex64 if x_complex else jnp.float32)
     bank_fft, L, is_complex = _bank_fft(wavelet, scales, n, float(w),
                                         x_complex)
