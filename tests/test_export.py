@@ -117,7 +117,7 @@ def test_standard_bundle(tmp_path, rng):
     path = vexport.standard_bundle(tmp_path / "dist", length=1024,
                                    batch=4, n=64)
     loaded = vexport.load_bundle(path)
-    assert len(loaded) == 12
+    assert len(loaded) == 17
 
     x = rng.standard_normal(1024, dtype=np.float32)
     # round-2 families round-trip too
@@ -138,6 +138,22 @@ def test_standard_bundle(tmp_path, rng):
     np.testing.assert_allclose(np.asarray(loaded["convolve"](x, h)),
                                np.asarray(ops.convolve(x, h)),
                                rtol=1e-3, atol=1e-3)
+    # round-3 families round-trip: conditioned peaks + Welch + scalogram
+    pos, val, count, _ = loaded["find_peaks_conditioned"](x)
+    wpos, wval, wcount, _ = ops.find_peaks_fixed(
+        x, capacity=64, height=0.0, distance=8.0, prominence=0.1)
+    np.testing.assert_array_equal(np.asarray(pos), np.asarray(wpos))
+    np.testing.assert_allclose(np.asarray(val), np.asarray(wval),
+                               atol=1e-6)
+    assert int(count) == int(wcount)
+    np.testing.assert_allclose(
+        np.asarray(loaded["welch_psd"](xb)),
+        np.asarray(ops.welch(xb, nfft=512, detrend="constant")),
+        rtol=1e-4, atol=1e-7)
+    scales = tuple(float(s) for s in np.geomspace(2, 32, 8))
+    np.testing.assert_allclose(
+        np.asarray(loaded["cwt_ricker_8scales"](x)),
+        np.asarray(ops.cwt(x, scales)), atol=1e-5)
 
 
 def test_exported_artifact_is_self_contained(tmp_path):

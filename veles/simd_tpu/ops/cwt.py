@@ -73,7 +73,12 @@ def _bank_fft(wavelet_name, scales, n, w, full_fft):
         # real wavelets keep the one-sided spectrum: rfft/irfft halves
         # the FLOPs and the dominant (batch, S, L) workspace
         bank_f = np.fft.rfft(bank.real, axis=-1).astype(np.complex64)
-    return jnp.asarray(bank_f), L, is_complex
+    # cache the HOST array: a cached device array materialized inside a
+    # trace (jax.export, jit) would leak that trace's tracer into later
+    # calls; jnp converts it per call and XLA dedups the constant.
+    # Read-only: the same object serves every later identical call.
+    bank_f.setflags(write=False)
+    return bank_f, L, is_complex
 
 
 @functools.partial(jax.jit, static_argnames=("L", "n", "mode"))

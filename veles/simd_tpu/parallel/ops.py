@@ -304,8 +304,8 @@ def _check_axis_divides(n_items, mesh, axis, what):
     n_shards = mesh.shape[axis]
     if n_items % n_shards:
         raise ValueError(
-            f"{what} length ({n_items}) must divide the {axis!r} "
-            f"mesh axis ({n_shards}); pad the {what} grid")
+            f"{what} length ({n_items}) must be a multiple of the "
+            f"{axis!r} mesh axis size ({n_shards}); pad the {what} grid")
 
 
 def lombscargle_sharded(t, y, freqs, *, mesh, axis="freq", weights=None,

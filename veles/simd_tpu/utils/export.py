@@ -190,5 +190,24 @@ def standard_bundle(path, *, length=4096, batch=128, n=1024,
         "sosfilt_butter6": (
             lambda x: O.sosfilt(x, sos),
             (a((batch, length), f32),)),
+        # round-3 families: conditioned peaks, Welch, scalogram,
+        # smoothing — the serving shapes of the new analysis surface
+        "find_peaks_conditioned": (
+            lambda x: O.find_peaks_fixed(
+                x, capacity=64, height=0.0, distance=8.0,
+                prominence=0.1),
+            (a((length,), f32),)),
+        "welch_psd": (
+            lambda x: O.welch(x, nfft=512, detrend="constant"),
+            (a((batch, length), f32),)),
+        "cwt_ricker_8scales": (
+            lambda x: O.cwt(x, tuple(float(s) for s in
+                                     np.geomspace(2, 32, 8))),
+            (a((length,), f32),)),
+        "medfilt_5": (
+            lambda x: O.medfilt(x, 5), (a((batch, length), f32),)),
+        "savgol_11_3": (
+            lambda x: O.savgol_filter(x, 11, 3),
+            (a((batch, length), f32),)),
     }
     return save_bundle(path, bundle, platforms=platforms)
