@@ -525,3 +525,40 @@ def test_fuzz_irregular_chunking(rng, seed):
     wpos, _, wcnt = ops.detect_peaks_fixed(x, capacity=n - 2)
     np.testing.assert_array_equal(np.array(got_pos),
                                   np.asarray(wpos)[:int(wcnt)])
+
+
+class TestWelchStream:
+    @pytest.mark.parametrize("chunk", [128, 512, 1024])
+    def test_final_estimate_matches_whole_signal(self, rng, chunk):
+        """Feeding the whole stream reproduces ops.welch EXACTLY: the
+        same real frames are averaged, warm-up frames masked."""
+        n, nfft, hop = 4096, 256, 64
+        x = rng.normal(size=n).astype(np.float32)
+        st = ops.welch_stream_init(nfft, hop)
+        est = None
+        for i in range(0, n, chunk):
+            st, est = ops.welch_stream_step(st, x[i:i + chunk],
+                                            nfft=nfft, hop=hop)
+        want = np.asarray(ops.welch(x, nfft=nfft, hop=hop))
+        np.testing.assert_allclose(np.asarray(est), want, rtol=1e-5,
+                                   atol=1e-9)
+
+    def test_batched_and_running(self, rng):
+        x = rng.normal(size=(3, 2048)).astype(np.float32)
+        st = ops.welch_stream_init(512, 128, batch_shape=(3,))
+        st, e1 = ops.welch_stream_step(st, x[:, :1024], nfft=512, hop=128)
+        st, e2 = ops.welch_stream_step(st, x[:, 1024:], nfft=512, hop=128)
+        assert e1.shape == e2.shape == (3, 257)
+        want = np.asarray(ops.welch(x, nfft=512, hop=128))
+        np.testing.assert_allclose(np.asarray(e2), want, rtol=1e-5,
+                                   atol=1e-9)
+
+    def test_warmup_only_returns_zeros(self, rng):
+        """A first chunk shorter than one full frame yields no real
+        frames: the estimate is zeros, not warm-up garbage."""
+        st = ops.welch_stream_init(256, 64)
+        st, est = ops.welch_stream_step(
+            st, rng.normal(size=64).astype(np.float32), nfft=256, hop=64)
+        assert int(st.n_frames) == 0
+        np.testing.assert_array_equal(np.asarray(est),
+                                      np.zeros(129, np.float32))

@@ -71,10 +71,11 @@ from veles.simd_tpu.ops.spectral import (  # noqa: F401
 from veles.simd_tpu.ops.stream import (  # noqa: F401
     FirStreamState, IstftStreamState, MinMaxStreamState, PeaksStreamState,
     ResampleStreamState, StftStreamState, SwtStreamReconState,
-    SwtStreamState, fir_stream_init, fir_stream_step, istft_stream_init,
-    istft_stream_step, minmax_stream_init, minmax_stream_step,
-    peaks_stream_init, peaks_stream_step, resample_stream_init,
-    resample_stream_step, stft_stream_init, stft_stream_step,
-    stft_stream_warmup, stream_scan, swt_stream_delay, swt_stream_init,
-    swt_stream_reconstruct_init, swt_stream_reconstruct_step,
-    swt_stream_step)
+    SwtStreamState, WelchStreamState, fir_stream_init, fir_stream_step,
+    istft_stream_init, istft_stream_step, minmax_stream_init,
+    minmax_stream_step, peaks_stream_init, peaks_stream_step,
+    resample_stream_init, resample_stream_step, stft_stream_init,
+    stft_stream_step, stft_stream_warmup, stream_scan, swt_stream_delay,
+    swt_stream_init, swt_stream_reconstruct_init,
+    swt_stream_reconstruct_step, swt_stream_step, welch_stream_init,
+    welch_stream_step)

@@ -552,3 +552,67 @@ def stream_scan(step, state, chunks, *step_args, **step_kwargs):
     def body(s, c):
         return step(s, c, *step_args, **step_kwargs)
     return jax.lax.scan(body, state, chunks)
+
+
+class WelchStreamState(NamedTuple):
+    """Carry for streaming Welch PSD: the STFT frame-overlap carry, the
+    running masked power MEAN (..., nfft//2+1) — a mean, not a sum, so
+    the accumulator magnitude stays bounded over unbounded streams —
+    and two scalar counters (frames accumulated; total frames emitted
+    incl. warm-up)."""
+    carry: jax.Array
+    psd_mean: jax.Array
+    n_frames: jax.Array
+    seen: jax.Array
+
+
+def welch_stream_init(nfft: int, hop: int | None = None,
+                      batch_shape=()) -> WelchStreamState:
+    """Start-of-stream state for :func:`welch_stream_step`: zero
+    prehistory and an empty accumulator. The estimator skips the
+    :func:`stft_stream_warmup` frames that window into the zero
+    prehistory, so the running estimate is always an average of REAL
+    frames only."""
+    hop = nfft // 4 if hop is None else hop
+    stft_stream_warmup(nfft, hop)  # validates the pair
+    return WelchStreamState(
+        jnp.zeros((*batch_shape, nfft - hop), jnp.float32),
+        jnp.zeros((*batch_shape, nfft // 2 + 1), jnp.float32),
+        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop"))
+def welch_stream_step(state: WelchStreamState, chunk, *, nfft: int,
+                      hop: int | None = None, window=None):
+    """One chunk -> (state', running PSD estimate (..., nfft//2+1)).
+
+    After the whole stream has been fed (chunk lengths multiples of
+    ``hop``), the estimate equals ``ops.welch`` of the concatenated
+    signal (the same frames averaged — warm-up frames into the zero
+    prehistory are masked out — under the same window-energy
+    normalization; running-mean accumulation keeps hour-scale streams
+    accurate where a raw f32 power sum would freeze). Before any real
+    frame has completed, the estimate is zeros."""
+    from veles.simd_tpu.ops import spectral
+
+    hop = nfft // 4 if hop is None else hop
+    warmup = stft_stream_warmup(nfft, hop)
+    w = (spectral.hann_window(nfft) if window is None
+         else jnp.asarray(window, jnp.float32))
+    st = StftStreamState(state.carry)
+    st, spec = stft_stream_step(st, chunk, nfft=nfft, hop=hop, window=w)
+    n_new = spec.shape[-2]
+    idx = state.seen + jnp.arange(n_new, dtype=jnp.int32)
+    valid = (idx >= warmup).astype(jnp.float32)  # mask warm-up frames
+    power = jnp.abs(spec) ** 2
+    k = jnp.sum(valid)
+    n_frames = state.n_frames + k.astype(jnp.int32)
+    # bounded-magnitude mean update: mean' = mean + (sum_new - k*mean)/n'
+    new_sum = jnp.einsum("...fk,f->...k", power, valid)
+    denom = jnp.maximum(n_frames, 1).astype(jnp.float32)
+    psd_mean = state.psd_mean + (new_sum - k * state.psd_mean) / denom
+    est = jnp.where(n_frames > 0,
+                    psd_mean / (jnp.sum(w * w) * nfft),
+                    jnp.zeros_like(psd_mean)).astype(jnp.float32)
+    return (WelchStreamState(st.carry, psd_mean, n_frames,
+                             state.seen + n_new), est)
