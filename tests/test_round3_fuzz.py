@@ -1,0 +1,165 @@
+"""Adversarial differential fuzz across the round-3 op families.
+
+Each case draws random shapes/parameters from a seeded generator and
+compares the device path against scipy float64 (the SURVEY §4 pattern:
+the oracle is the other backend). Complements the per-family suites
+with the odd sizes and parameter corners nobody writes by hand.
+"""
+
+import numpy as np
+import pytest
+import scipy.signal as ss
+
+from veles.simd_tpu import ops
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_lfilter_random_designs(seed):
+    g = np.random.default_rng(7000 + seed)
+    order = int(g.integers(1, 8))
+    wn = float(g.uniform(0.05, 0.45))
+    btype = ("lowpass", "highpass")[seed % 2]
+    b, a = ss.butter(order, wn, btype)
+    n = int(g.integers(16, 3000))
+    x = g.normal(size=n).astype(np.float32)
+    want = ss.lfilter(b, a, x.astype(np.float64))
+    got = np.asarray(ops.lfilter(b, a, x))
+    scale = np.abs(want).max() + 1.0
+    np.testing.assert_allclose(got / scale, want / scale, atol=5e-5,
+                               err_msg=f"seed={seed} o={order} wn={wn}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_medfilt_savgol_random(seed):
+    g = np.random.default_rng(7100 + seed)
+    n = int(g.integers(30, 800))
+    x = g.normal(size=n).astype(np.float32)
+    k = int(g.integers(1, 12)) * 2 + 1  # 3..23, always <= n (>= 30)
+    np.testing.assert_allclose(
+        np.asarray(ops.medfilt(x, k)),
+        ss.medfilt(x.astype(np.float64), k),
+        atol=1e-6, err_msg=f"seed={seed} k={k} n={n}")
+    wl = int(g.integers(2, min(12, n // 2))) * 2 + 1
+    po = int(g.integers(1, wl - 1))
+    mode = ("mirror", "nearest", "wrap", "constant")[seed % 4]
+    want = ss.savgol_filter(x.astype(np.float64), wl, po, mode=mode)
+    got = np.asarray(ops.savgol_filter(x, wl, po, mode=mode))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3,
+                               err_msg=f"seed={seed} wl={wl} po={po}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fourier_resample_random(seed):
+    g = np.random.default_rng(7200 + seed)
+    n = int(g.integers(8, 2000))
+    num = int(g.integers(4, 2000))
+    x = g.normal(size=n).astype(np.float32)
+    want = ss.resample(x.astype(np.float64), num)
+    got = np.asarray(ops.resample(x, num))
+    scale = np.abs(want).max() + 1.0
+    np.testing.assert_allclose(got / scale, want / scale, atol=5e-5,
+                               err_msg=f"seed={seed} {n}->{num}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_czt_random_spirals(seed):
+    g = np.random.default_rng(7300 + seed)
+    n = int(g.integers(4, 1200))
+    m = int(g.integers(1, 1200))
+    x = g.normal(size=n).astype(np.float32)
+    # keep the spiral inside czt's accurate-f32 envelope: past ~e^10 of
+    # chirp-magnitude span, cancellation across decades erodes single
+    # precision (the op hard-rejects only the e^80 overflow point)
+    kmax = max(n, m)
+    dw = 8.0 / (kmax * kmax)  # exponent budget for |log w|
+    r_w = float(np.exp(g.uniform(-dw, dw)))
+    w = r_w * np.exp(-2j * np.pi * g.uniform(0.1, 0.9) / max(m, 2))
+    da = 2.0 / n
+    a = float(np.exp(g.uniform(-da, da))) * np.exp(
+        2j * np.pi * g.uniform(0, 1))
+    want = ss.czt(x.astype(np.float64), m=m, w=w, a=a)
+    got = np.asarray(ops.czt(x, m=m, w=w, a=a))
+    scale = np.abs(want).max() + 1e-6
+    np.testing.assert_allclose(got / scale, want / scale, atol=1e-4,
+                               err_msg=f"seed={seed} n={n} m={m}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_cwt_random_scales(seed):
+    from veles.simd_tpu.reference import cwt as ref_cwt
+
+    g = np.random.default_rng(7400 + seed)
+    n = int(g.integers(16, 700))
+    x = g.normal(size=n).astype(np.float32)
+    scales = tuple(float(s) for s in
+                   np.sort(g.uniform(0.2, n / 4, size=int(g.integers(1, 6)))))
+    wavelet = ("ricker", "morlet2")[seed % 2]
+    fn = ref_cwt.ricker if wavelet == "ricker" else ref_cwt.morlet2
+    want = ref_cwt.cwt(x, fn, scales)
+    got = np.asarray(ops.cwt(x, scales, wavelet))
+    scale = np.abs(want).max() + 1e-6
+    np.testing.assert_allclose(got / scale, want / scale, atol=2e-5,
+                               err_msg=f"seed={seed} n={n} {scales}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_find_peaks_random_conditions(seed):
+    g = np.random.default_rng(7500 + seed)
+    n = int(g.integers(10, 1200))
+    x = g.normal(size=n).astype(np.float32)
+    kw = {}
+    if g.random() < 0.6:
+        kw["height"] = float(g.uniform(-0.5, 1.0))
+    if g.random() < 0.5:
+        kw["prominence"] = float(g.uniform(0.05, 1.0))
+    if g.random() < 0.4:
+        kw["width"] = float(g.uniform(0.5, 4.0))
+    want_pos, _ = ss.find_peaks(x.astype(np.float64), **kw)
+    pos, _, count, _ = ops.find_peaks_fixed(x, capacity=1024, **kw)
+    got = np.asarray(pos)[:int(count)]
+    np.testing.assert_array_equal(got, want_pos,
+                                  err_msg=f"seed={seed} n={n} kw={kw}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_dlsim_random_systems(seed):
+    g = np.random.default_rng(7600 + seed)
+    S = int(g.integers(1, 7))
+    n_in = int(g.integers(1, 4))
+    n_out = int(g.integers(1, 4))
+    A = g.normal(size=(S, S))
+    A *= float(g.uniform(0.3, 0.95)) / max(
+        np.abs(np.linalg.eigvals(A)).max(), 1e-9)
+    B = g.normal(size=(S, n_in))
+    C = g.normal(size=(n_out, S))
+    D = g.normal(size=(n_out, n_in))
+    n = int(g.integers(2, 900))
+    u = g.normal(size=(n, n_in)).astype(np.float32)
+    _, want_y, _ = ss.dlsim((A, B, C, D, 1.0), u.astype(np.float64))
+    y, _ = ops.dlsim((A, B, C, D), u)
+    want_y = want_y.reshape(n, n_out)
+    scale = np.abs(want_y).max() + 1.0
+    np.testing.assert_allclose(np.asarray(y) / scale, want_y / scale,
+                               atol=5e-4,
+                               err_msg=f"seed={seed} S={S} n={n}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_welch_family_random(seed):
+    from veles.simd_tpu.reference import spectral as refs
+
+    g = np.random.default_rng(7700 + seed)
+    nfft = int(2 ** g.integers(4, 9))
+    hop = nfft // int(2 ** g.integers(0, 3))
+    n = nfft * int(g.integers(2, 9)) + hop * int(g.integers(0, 4))
+    x = (g.normal(size=n) + g.uniform(-3, 3)).astype(np.float32)
+    y = g.normal(size=n).astype(np.float32)
+    detrend = (None, "constant", "linear")[seed % 3]
+    np.testing.assert_allclose(
+        np.asarray(ops.welch(x, nfft=nfft, hop=hop, detrend=detrend)),
+        refs.welch(x, nfft=nfft, hop=hop, detrend=detrend),
+        rtol=2e-3, atol=1e-6, err_msg=f"seed={seed} nfft={nfft}")
+    np.testing.assert_allclose(
+        np.asarray(ops.csd(x, y, nfft=nfft, hop=hop, detrend=detrend)),
+        refs.csd(x, y, nfft=nfft, hop=hop, detrend=detrend),
+        atol=2e-5, err_msg=f"seed={seed}")

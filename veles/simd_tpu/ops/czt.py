@@ -16,6 +16,13 @@ device never evaluates a large-angle transcendental.
 ``zoom_fft`` evaluates a dense DFT over just [f1, f2) without computing
 the full spectrum: the classic "more resolution in one band" tool.
 
+Off-circle conditioning: spirals with ``|w| != 1`` or ``|a| != 1`` make
+the chirp magnitudes span ``exp((k^2/2)|log|w|| + n|log|a||)``; float32
+stays accurate to ~1e-5 while that span is under ~e^10, degrades
+gradually beyond, and the op rejects spirals past e^80 (where the
+constants overflow outright). Unit-circle transforms — the DFT/zoom
+cases — are unaffected at any size.
+
 Oracle: scipy.signal.czt / zoom_fft via ``impl="reference"``
 (tests/test_czt.py differentials).
 """
@@ -94,6 +101,19 @@ def _czt_impl(x, m, w, a, impl):
     a = complex(a)
     if w == 0 or a == 0:
         raise ValueError("w and a must be nonzero")
+    # off-circle conditioning: chirp magnitudes grow like
+    # |w|^(k^2/2) * |a|^-n — past e^80 they overflow the float32
+    # constants outright (scipy's f64 path merely returns numbers
+    # spanning dozens of decades, equally useless downstream)
+    kmax = max(n, m)
+    emax = (kmax * kmax / 2.0) * abs(np.log(abs(w))) \
+        + n * abs(np.log(abs(a)))
+    if emax > 80.0:
+        raise ValueError(
+            f"spiral too steep for float32: |w|={abs(w):.6g}, "
+            f"|a|={abs(a):.6g} at n={n}, m={m} spans e^{emax:.0f} in "
+            f"chirp magnitude; reduce |log|w||/|log|a|| or transform "
+            f"shorter blocks")
     if resolve_impl(impl) == "reference":
         from scipy.signal import czt as _czt
         return _czt(np.asarray(x), m=m, w=w, a=a, axis=-1)
