@@ -199,3 +199,46 @@ class TestArgrel:
             ops.argrelmax(np.zeros(8, np.float32), order=0)
         with pytest.raises(ValueError):
             ops.argrelmax(np.zeros(8, np.float32), mode="reflect")
+
+
+def test_traced_condition_values_under_jit(rng):
+    """Condition values may be jax tracers: an adaptive (data-dependent)
+    height threshold computed INSIDE jit works and matches the same
+    threshold applied concretely."""
+    import jax
+    import jax.numpy as jnp
+
+    x = rng.normal(size=400).astype(np.float32)
+
+    @jax.jit
+    def adaptive(sig):
+        thresh = jnp.median(sig) + jnp.std(sig)
+        return ops.find_peaks_fixed(sig, capacity=64, height=thresh,
+                                    distance=jnp.float32(3.0))
+
+    pos, val, count, _ = adaptive(x)
+    t = float(np.median(x) + x.std())
+    wpos, wval, wcount, _ = ops.find_peaks_fixed(x, capacity=64,
+                                                 height=t, distance=3)
+    np.testing.assert_array_equal(np.asarray(pos), np.asarray(wpos))
+    assert int(count) == int(wcount)
+
+
+def test_traced_interval_pair_under_jit(rng):
+    """(lo, hi) condition pairs of tracers work too (review r3)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = rng.normal(size=300).astype(np.float32)
+
+    @jax.jit
+    def band(sig):
+        lo = jnp.median(sig)
+        return ops.find_peaks_fixed(sig, capacity=64,
+                                    height=(lo, lo + 1.0))
+
+    pos, _, count, _ = band(x)
+    lo = float(np.median(x))
+    wpos, _, wcount, _ = ops.find_peaks_fixed(x, capacity=64,
+                                              height=(lo, lo + 1.0))
+    np.testing.assert_array_equal(np.asarray(pos), np.asarray(wpos))

@@ -22,3 +22,5 @@ from veles.simd_tpu.models.image import ImageWaveletDenoiser  # noqa: F401
 from veles.simd_tpu.models.pipeline import SignalPipeline  # noqa: F401
 from veles.simd_tpu.models.spectral import SpectralPeakAnalyzer  # noqa: F401
 from veles.simd_tpu.models.streaming import StreamingWaveletDenoiser  # noqa: F401
+from veles.simd_tpu.models.transient import (  # noqa: F401
+    TransientScalogramDetector)

@@ -1,0 +1,83 @@
+"""Scalogram transient detector: CWT ridge energy + conditioned peaks.
+
+The time-domain twin of models.SpectralPeakAnalyzer for events that a
+stationary PSD washes out (bursts, spikes, chirplets): a morlet2
+scalogram localizes energy jointly in time and scale, the per-time
+ridge maximum collapses it to a 1-D transient-energy envelope, and
+scipy-conditioned peak finding (distance + prominence, fixed capacity)
+extracts the events. One batched FFT multiply for the whole scale bank
+(ops/cwt.py) plus the fixed-capacity peak machinery — no data-dependent
+shapes anywhere, so the full detector jits and vmaps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu import ops
+
+
+class TransientScalogramDetector:
+    """Detect transient events -> (positions, strengths, scales, count).
+
+    ``scales`` defaults to a geometric grid; an event's reported scale
+    is the ridge argmax at its time index (which wavelet scale carried
+    the energy — a duration estimate). ``distance``/``prominence``
+    condition the peaks on the ridge envelope; ``capacity`` bounds the
+    event count (positions pad with -1). With ``prominence=None`` the
+    median+6*MAD height gate alone admits occasional finest-scale noise
+    spikes — set ``prominence`` (ridge units; ~4 works for SNR >= 1
+    bursts) or filter events by their reported scale to reject them.
+    """
+
+    def __init__(self, scales=None, *, w=6.0, capacity=32,
+                 distance=64.0, prominence=None):
+        self.scales = (tuple(float(s) for s in
+                             np.geomspace(2.0, 64.0, 24))
+                       if scales is None else
+                       tuple(float(s) for s in scales))
+        self.w = float(w)
+        self.capacity = int(capacity)
+        self.distance = float(distance)
+        self.prominence = prominence
+
+    def __call__(self, signal):
+        """1-D signal -> (positions, strengths, scales, count); use
+        ``jax.vmap`` over a leading batch axis."""
+        return _detect(jnp.asarray(signal, jnp.float32), self.scales,
+                       self.w, self.capacity, self.distance,
+                       self.prominence)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "scales", "w", "capacity", "distance", "prominence"))
+def _detect(x, scales, w, capacity, distance, prominence):
+    mag = jnp.abs(ops.cwt(x, scales, "morlet2", w=w))  # (S, n)
+    # per-scale normalization: |psi| integrates differently per scale,
+    # so raw magnitudes bias toward coarse scales; normalizing by each
+    # scale's own median flattens the background noise floor
+    floor = jnp.median(mag, axis=-1, keepdims=True)
+    rel = mag / jnp.maximum(floor, 1e-12)
+    ridge = jnp.max(rel, axis=0)            # transient-energy envelope
+    ridge_arg = jnp.argmax(rel, axis=0)     # which scale carried it
+    # adaptive height: median + 6*MAD of the ridge — a TRACED condition
+    # value (find_peaks_fixed supports those), pruning the thousands of
+    # noise maxima BEFORE the fixed-capacity compaction so `capacity`
+    # only needs to cover real events
+    med = jnp.median(ridge)
+    mad = jnp.median(jnp.abs(ridge - med))
+    pos, val, count, _ = ops.find_peaks_fixed(
+        ridge, capacity=capacity, height=med + 6.0 * mad,
+        distance=distance, prominence=prominence)
+    # scale of each event: a K-element gather of ridge_arg at the peak
+    # indices (the slot axis is tiny — K gathers of ints are trivial
+    # and exact, no one-hot float detour)
+    n = ridge.shape[-1]
+    scale_idx = jnp.take(ridge_arg, jnp.clip(pos, 0, n - 1))
+    scales_arr = jnp.asarray(scales, jnp.float32)
+    ev_scales = jnp.where(pos >= 0, scales_arr[scale_idx], 0.0)
+    return pos, val, ev_scales, count

@@ -42,14 +42,20 @@ from veles.simd_tpu.ops.detect_peaks import _compact_mask
 
 
 def _interval(arg):
-    """Normalize scipy's scalar-or-(min, max) condition arguments."""
+    """Normalize scipy's scalar-or-(min, max) condition arguments.
+    Values stay as given — a jax tracer is a legal condition value
+    (data-dependent thresholds under jit); only None-ness is static."""
     if arg is None:
         return None, None
+    # structural pair test first: np.ndim would np.asarray a (lo, hi)
+    # tuple, which crashes on a pair of tracers
+    if isinstance(arg, (tuple, list)):
+        lo, hi = arg
+        return lo, hi
     if np.ndim(arg) == 0:
-        return float(arg), None
+        return arg, None
     lo, hi = arg
-    return (None if lo is None else float(lo),
-            None if hi is None else float(hi))
+    return lo, hi
 
 
 def _plateau_maxima(x):
@@ -254,8 +260,11 @@ def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
     prominences/left_bases/right_bases/widths/width_heights/left_ips/
     right_ips (fixed (capacity,) arrays) whenever ``prominence`` or
     ``width`` conditions are given, else is empty. Conditions accept a
-    scalar minimum or a ``(min, max)`` pair like scipy; filtering order
-    (height, threshold, distance, prominence, width) matches scipy.
+    scalar minimum or a ``(min, max)`` pair like scipy — and the VALUES
+    may be jax tracers (adaptive, data-dependent thresholds computed
+    inside jit; only which conditions are present is static). Filtering
+    order (height, threshold, distance, prominence, width) matches
+    scipy.
 
     Sizing ``capacity``: candidates compact into the fixed slots right
     after the cheap vector conditions (height/threshold), BEFORE
@@ -270,8 +279,9 @@ def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
                          f"got shape {np.shape(x)}; vmap for batches")
     if np.shape(x)[-1] < 3:
         raise ValueError("need at least 3 samples")
-    if distance is not None and distance < 1:
-        raise ValueError("distance must be >= 1")
+    if distance is not None and not isinstance(
+            distance, jax.core.Tracer) and distance < 1:
+        raise ValueError("distance must be >= 1")  # concrete-only check
     impl = resolve_impl(impl)
     if impl == "reference":
         return _find_peaks_reference(x, capacity, height, threshold,
@@ -282,18 +292,34 @@ def find_peaks_fixed(x, *, capacity=64, height=None, threshold=None,
               _interval(prominence), _interval(width)]
     flat = [b for pair in bounds for b in pair]
     flags = tuple(b is not None for b in flat)
-    cv = np.zeros(10, np.float32)
-    cv[:8] = [0.0 if b is None else b for b in flat]
-    # vector layout: interval bounds land at _HMIN.._TMAX and
-    # _PMIN.._WMAX; reorder from [h, t, p, w] pairs to slot order
-    cv = np.array([cv[0], cv[1], cv[2], cv[3],
-                   0.0 if distance is None else float(np.ceil(distance)),
-                   cv[4], cv[5], cv[6], cv[7],
-                   float(rel_height)], np.float32)
+
+    # traced condition values are legal (adaptive thresholds inside
+    # jit); only presence is static. Eager calls with plain numbers
+    # keep the one-host-array construction (no per-value dispatches).
+    raw = [flat[0], flat[1], flat[2], flat[3], distance, flat[4],
+           flat[5], flat[6], flat[7], rel_height]
+    if any(isinstance(v, jax.core.Tracer) for v in raw):
+        def entry(v):
+            return jnp.asarray(0.0 if v is None else v, jnp.float32)
+
+        dist_v = (jnp.float32(0.0) if distance is None
+                  else jnp.ceil(jnp.asarray(distance, jnp.float32)))
+        # vector layout: interval bounds land at _HMIN.._TMAX and
+        # _PMIN.._WMAX; reorder from [h, t, p, w] pairs to slot order
+        cv = jnp.stack([entry(flat[0]), entry(flat[1]), entry(flat[2]),
+                        entry(flat[3]), dist_v, entry(flat[4]),
+                        entry(flat[5]), entry(flat[6]), entry(flat[7]),
+                        jnp.asarray(rel_height, jnp.float32)])
+    else:
+        cv = jnp.asarray(np.array(
+            [0.0 if v is None else float(v) for v in raw[:4]]
+            + [0.0 if distance is None else float(np.ceil(distance))]
+            + [0.0 if v is None else float(v) for v in raw[5:9]]
+            + [float(rel_height)], np.float32))
     flags = (flags[0], flags[1], flags[2], flags[3], False,
              flags[4], flags[5], flags[6], flags[7], False)
     need_prom = prominence is not None or width is not None
-    return _find_peaks_xla(x, jnp.asarray(cv), int(capacity), flags,
+    return _find_peaks_xla(x, cv, int(capacity), flags,
                            distance is not None, need_prom)
 
 
