@@ -163,3 +163,64 @@ def test_welch_family_random(seed):
         np.asarray(ops.csd(x, y, nfft=nfft, hop=hop, detrend=detrend)),
         refs.csd(x, y, nfft=nfft, hop=hop, detrend=detrend),
         atol=2e-5, err_msg=f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_conv_corr_modes_random(seed):
+    """mode='same'/'valid' slicing vs scipy across odd/even kernels,
+    1-D and 2-D, convolve and correlate (centering conventions differ
+    between correlate and correlate2d — scipy's own quirk)."""
+    g = np.random.default_rng(7800 + seed)
+    n = int(g.integers(8, 300))
+    m = int(g.integers(1, min(n, 40)))
+    x = g.normal(size=n).astype(np.float32)
+    h = g.normal(size=m).astype(np.float32)
+    for mode in ("full", "same", "valid"):
+        np.testing.assert_allclose(
+            np.asarray(ops.convolve(x, h, mode=mode)),
+            ss.convolve(x.astype(np.float64), h.astype(np.float64),
+                        mode), rtol=1e-3, atol=1e-4,
+            err_msg=f"convolve seed={seed} {mode} n={n} m={m}")
+        np.testing.assert_allclose(
+            np.asarray(ops.cross_correlate(x, h, mode=mode)),
+            ss.correlate(x.astype(np.float64), h.astype(np.float64),
+                         mode), rtol=1e-3, atol=1e-4,
+            err_msg=f"correlate seed={seed} {mode}")
+    H, W = int(g.integers(4, 30)), int(g.integers(4, 30))
+    kh, kw = int(g.integers(1, H + 1)), int(g.integers(1, W + 1))
+    img = g.normal(size=(H, W)).astype(np.float32)
+    k2 = g.normal(size=(kh, kw)).astype(np.float32)
+    for mode in ("full", "same", "valid"):
+        np.testing.assert_allclose(
+            np.asarray(ops.convolve2D(img, k2, mode=mode)),
+            ss.convolve2d(img.astype(np.float64),
+                          k2.astype(np.float64), mode),
+            rtol=1e-3, atol=1e-3,
+            err_msg=f"conv2d seed={seed} {mode} k=({kh},{kw})")
+        np.testing.assert_allclose(
+            np.asarray(ops.cross_correlate2D(img, k2, mode=mode)),
+            ss.correlate2d(img.astype(np.float64),
+                           k2.astype(np.float64), mode),
+            rtol=1e-3, atol=1e-3,
+            err_msg=f"corr2d seed={seed} {mode} k=({kh},{kw})")
+
+
+def test_valid_mode_swaps_when_kernel_longer(rng):
+    """scipy's 1-D valid with n < m swaps the operands; 2-D raises
+    (scipy's own split) — and the f64 oracle stays f64 numpy."""
+    x = rng.normal(size=5).astype(np.float32)
+    h = rng.normal(size=10).astype(np.float32)
+    want = ss.convolve(x.astype(np.float64), h.astype(np.float64),
+                       "valid")
+    got = np.asarray(ops.convolve(x, h, mode="valid"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ops.cross_correlate(x, h, mode="valid")),
+        ss.correlate(x.astype(np.float64), h.astype(np.float64),
+                     "valid"), rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError):
+        ops.convolve2D(np.zeros((3, 3), np.float32),
+                       np.ones((5, 5), np.float32), mode="valid")
+    ref = ops.convolve2D(np.zeros((6, 6)), np.ones((3, 3)),
+                         mode="same", impl="reference")
+    assert ref.dtype == np.float64  # oracle never downcasts

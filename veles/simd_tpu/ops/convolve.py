@@ -335,8 +335,36 @@ def convolve_finalize(handle: ConvolutionHandle) -> None:
     """API-parity no-op: XLA owns FFT plan and buffer lifetimes."""
 
 
-def convolve(x, h, *, algorithm: Optional[str] = None, impl=None):
-    """Full linear convolution, length x+h-1 (one-shot form).
+def mode_slice(full, n, m, mode, *, same_offset=None, valid_swap=True):
+    """Slice a full linear convolution/correlation (..., n+m-1) down to
+    scipy's ``mode`` ("full" | "same" | "valid") along the last axis.
+    Backend-agnostic (pure slicing — numpy oracles stay f64 and never
+    touch the jax backend). ``same_offset`` overrides the (m-1)//2
+    centering (correlate2d centers at k//2); ``valid_swap`` mirrors
+    scipy's 1-D behavior of swapping the operands when n < m (the 2-D
+    family raises there instead, like scipy's convolve2d)."""
+    if mode == "full":
+        return full
+    if mode == "same":
+        lo = (m - 1) // 2 if same_offset is None else same_offset
+        return full[..., lo:lo + n]
+    if mode == "valid":
+        if n < m:
+            if not valid_swap:
+                raise ValueError(
+                    f"mode='valid' needs the signal (n={n}) at least "
+                    f"as long as the kernel (m={m})")
+            return full[..., n - 1:m]  # scipy swaps the operands
+        return full[..., m - 1:n]
+    raise ValueError(f"mode must be 'full', 'same' or 'valid', "
+                     f"got {mode!r}")
+
+
+def convolve(x, h, *, mode: str = "full",
+             algorithm: Optional[str] = None, impl=None):
+    """Linear convolution (one-shot form): ``mode`` is scipy's
+    "full" (length n+m-1, the default and the C API's shape),
+    "same" (center n samples) or "valid" (kernel fully inside).
 
     Batch-aware: leading axes of ``x`` broadcast through all three
     algorithms (the reference is strictly 1-D, convolve.h:41-125;
@@ -344,12 +372,13 @@ def convolve(x, h, *, algorithm: Optional[str] = None, impl=None):
     """
     impl = resolve_impl(impl)
     if impl == "reference":
-        return _ref.convolve(x, h)
+        full = _ref.convolve(x, h)
+        return mode_slice(full, np.shape(x)[-1], np.shape(h)[-1], mode)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
     handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm,
                                  impl=impl)
-    return handle(x, h)
+    return mode_slice(handle(x, h), x.shape[-1], h.shape[-1], mode)
 
 
 # ---------------------------------------------------------------------------
@@ -394,8 +423,23 @@ def _convolve2d_fft_xla(x, h, fh, fw):
 _DIRECT2D_MAX_TAPS = 192
 
 
-def convolve2D(x, h, *, algorithm: Optional[str] = None, impl=None):
-    """Full 2-D linear convolution -> (..., H+kh-1, W+kw-1).
+def _mode_slice2d(full, shape_hw, shape_kk, mode, same_offsets=None):
+    """Apply :func:`mode_slice` to both trailing axes of a full 2-D
+    convolution (scipy.signal.convolve2d's mode semantics: valid
+    requires the kernel to fit — no operand swap). The `.swapaxes`
+    METHOD keeps numpy oracles in numpy and device arrays on device."""
+    offs = (None, None) if same_offsets is None else same_offsets
+    rows = mode_slice(full.swapaxes(-1, -2), shape_hw[0], shape_kk[0],
+                      mode, same_offset=offs[0], valid_swap=False)
+    return mode_slice(rows.swapaxes(-1, -2), shape_hw[1], shape_kk[1],
+                      mode, same_offset=offs[1], valid_swap=False)
+
+
+def convolve2D(x, h, *, mode: str = "full",
+               algorithm: Optional[str] = None, impl=None):
+    """2-D linear convolution -> full (..., H+kh-1, W+kw-1) by default;
+    ``mode`` in {"full", "same", "valid"} applies scipy.signal
+    .convolve2d's slicing to both trailing axes.
 
     ``algorithm``: "direct" (fused shift-add, small kernels) or "fft"
     (batched rfft2); None picks by tap count (direct up to
@@ -403,9 +447,12 @@ def convolve2D(x, h, *, algorithm: Optional[str] = None, impl=None):
     separable kernels prefer :func:`convolve2D_separable`
     (O(kh+kw) per pixel).
     """
+    hw = np.shape(x)[-2:]
+    kk = np.shape(h)
     impl = resolve_impl(impl)
     if impl == "reference":
-        return _ref.convolve2D(x, h)
+        full = _ref.convolve2D(x, h)  # stays f64 numpy end to end
+        return _mode_slice2d(np.asarray(full), hw, kk, mode)
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
     if x.ndim < 2 or h.ndim != 2:
@@ -420,12 +467,14 @@ def convolve2D(x, h, *, algorithm: Optional[str] = None, impl=None):
                 f"direct 2-D convolution caps at {_DIRECT_UNROLL_MAX_H} "
                 "taps (compile time is linear in the unroll); use "
                 "algorithm='fft'")
-        return _convolve2d_direct_xla(x, h)
-    if algorithm != "fft":
+        full = _convolve2d_direct_xla(x, h)
+    elif algorithm != "fft":
         raise ValueError("algorithm must be 'direct', 'fft', or None")
-    fh = fft_convolution_length(x.shape[-2], h.shape[-2])
-    fw = fft_convolution_length(x.shape[-1], h.shape[-1])
-    return _convolve2d_fft_xla(x, h, fh, fw)
+    else:
+        fh = fft_convolution_length(x.shape[-2], h.shape[-2])
+        fw = fft_convolution_length(x.shape[-1], h.shape[-1])
+        full = _convolve2d_fft_xla(x, h, fh, fw)
+    return _mode_slice2d(full, hw, kk, mode)
 
 
 def convolve2D_separable(x, h_row, h_col, *, impl=None):

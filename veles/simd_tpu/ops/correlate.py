@@ -35,15 +35,22 @@ def cross_correlate_finalize(handle) -> None:
     """API-parity no-op."""
 
 
-def cross_correlate(x, h, *, algorithm: Optional[str] = None, impl=None):
+def cross_correlate(x, h, *, mode: str = "full",
+                    algorithm: Optional[str] = None, impl=None):
+    """Cross-correlation; ``mode`` is scipy's "full" (default, the C
+    API's n+m-1 shape), "same" or "valid" — 1-D correlation shares
+    convolution's slice offsets (scipy.signal.correlate's contract)."""
+    from veles.simd_tpu.ops.convolve import mode_slice
+
     impl = resolve_impl(impl)
     if impl == "reference":
-        return _ref.cross_correlate(x, h)
+        full = _ref.cross_correlate(x, h)
+        return mode_slice(full, np.shape(x)[-1], np.shape(h)[-1], mode)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
     handle = cross_correlate_initialize(x.shape[-1], h.shape[-1], algorithm,
                                         impl=impl)
-    return handle(x, h)
+    return mode_slice(handle(x, h), x.shape[-1], h.shape[-1], mode)
 
 
 def cross_correlate_simd(x, h, *, impl=None):
@@ -58,19 +65,31 @@ def cross_correlate_overlap_save(x, h, *, impl=None):
     return cross_correlate(x, h, algorithm="overlap_save", impl=impl)
 
 
-def cross_correlate2D(x, h, *, algorithm: Optional[str] = None, impl=None):
-    """Full 2-D cross-correlation -> (..., H+kh-1, W+kw-1)
-    (scipy.signal.correlate2d mode="full" for real inputs): delegates to
-    :func:`ops.convolve2D` with the kernel flipped on both axes — the
-    same reverse-flag relationship the 1-D pair uses
-    (src/correlate.c:128-142's pattern, one dimension up). Leading axes
-    of ``x`` are batch."""
+def cross_correlate2D(x, h, *, mode: str = "full",
+                      algorithm: Optional[str] = None, impl=None):
+    """2-D cross-correlation (scipy.signal.correlate2d semantics for
+    real inputs): delegates to :func:`ops.convolve2D` with the kernel
+    flipped on both axes — the same reverse-flag relationship the 1-D
+    pair uses (src/correlate.c:128-142's pattern, one dimension up).
+    ``mode`` in {"full", "same", "valid"}; note correlate2d's "same"
+    centers at k//2 per axis (NOT (k-1)//2 — the kernel flip shifts the
+    center for even sizes, scipy's own convention). Leading axes of
+    ``x`` are batch."""
     impl = resolve_impl(impl)
     from veles.simd_tpu.ops.convolve import convolve2D
 
     if np.ndim(h) != 2:
         raise ValueError(f"h must be 2-D; got shape {np.shape(h)}")
+    from veles.simd_tpu.ops.convolve import _mode_slice2d
+
+    hw = np.shape(x)[-2:]
+    kk = np.shape(h)
     if impl == "reference":  # full-precision taps for the f64 oracle
-        return convolve2D(x, np.asarray(h)[::-1, ::-1], impl="reference")
-    h = jnp.asarray(h, jnp.float32)
-    return convolve2D(x, h[::-1, ::-1], algorithm=algorithm, impl=impl)
+        full = convolve2D(x, np.asarray(h)[::-1, ::-1], impl="reference")
+    else:
+        h = jnp.asarray(h, jnp.float32)
+        full = convolve2D(x, h[::-1, ::-1], algorithm=algorithm,
+                          impl=impl)
+    # correlate2d centers "same" at k//2 (the flipped-kernel shift)
+    return _mode_slice2d(full, hw, kk, mode,
+                         same_offsets=(kk[0] // 2, kk[1] // 2))
