@@ -444,3 +444,14 @@ class TestSosfiltfiltPadded:
         want = sp_decimate(x.astype(np.float64), 4)
         got = np.asarray(ops.decimate(x, 4))
         np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_partial_fraction_passthroughs():
+    import scipy.signal as ss
+
+    b, a = ss.butter(3, 0.3)
+    r, p, k = ops.residuez(b, a)
+    wr, wp, wk = ss.residuez(b, a)
+    np.testing.assert_allclose(r, wr, atol=1e-12)
+    bb, aa = ops.invresz(r, p, k)
+    np.testing.assert_allclose(np.real(bb), b, atol=1e-8)

@@ -78,7 +78,7 @@ class TestSavgol:
         with pytest.raises(ValueError):
             ops.savgol_filter(x, 5, 5)          # polyorder >= window
         with pytest.raises(ValueError):
-            ops.savgol_filter(x, 5, 2, mode="interp")  # not offered
+            ops.savgol_filter(x, 5, 2, mode="reflect")  # not a scipy mode
 
 
 def test_firwin_passthrough():
@@ -206,3 +206,29 @@ class TestMedfilt2d:
         assert ops.medfilt2d(empty, 3, impl="reference").shape == (4, 0)
         zb = np.zeros((0, 8, 8), np.float32)
         assert ops.medfilt2d(zb, 3, impl="reference").shape == (0, 8, 8)
+
+
+class TestSavgolInterp:
+    @pytest.mark.parametrize("wl,po,deriv", [(5, 2, 0), (11, 3, 0),
+                                             (11, 3, 1), (21, 4, 2)])
+    def test_matches_scipy_default_everywhere(self, rng, wl, po, deriv):
+        """mode='interp' (now the default, like scipy) matches
+        scipy.signal.savgol_filter INCLUDING the refit edges."""
+        from scipy.signal import savgol_filter as sp_savgol
+
+        x = rng.normal(size=200).astype(np.float32)
+        want = sp_savgol(x.astype(np.float64), wl, po, deriv=deriv,
+                         delta=0.5)
+        got = np.asarray(ops.savgol_filter(x, wl, po, deriv=deriv,
+                                           delta=0.5))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_batched_and_short_signal(self, rng):
+        from scipy.signal import savgol_filter as sp_savgol
+
+        x = rng.normal(size=(3, 64)).astype(np.float32)
+        want = sp_savgol(x.astype(np.float64), 9, 2, axis=-1)
+        got = np.asarray(ops.savgol_filter(x, 9, 2))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        with pytest.raises(ValueError, match="interp"):
+            ops.savgol_filter(np.zeros(5, np.float32), 9, 2)

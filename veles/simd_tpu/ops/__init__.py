@@ -54,8 +54,9 @@ from veles.simd_tpu.ops.iir import (  # noqa: F401
     group_delay, iircomb, iirdesign, iirfilter, iirnotch, iirpeak,
     iir_stream_init, iir_stream_step, kaiser_atten, kaiser_beta,
     kaiserord, lfilter, lfilter_zi, lp2bp, lp2bs, lp2hp, lp2lp,
-    minimum_phase, remez, sos2tf, sos2zpk, sosfilt, sosfiltfilt,
-    sosfilt_zi, sosfreqz, tf2sos, tf2zpk, zpk2sos, zpk2tf)
+    minimum_phase, remez, residue, residuez, invres, invresz, sos2tf,
+    sos2zpk, sosfilt, sosfiltfilt, sosfilt_zi, sosfreqz, tf2sos,
+    tf2zpk, unique_roots, zpk2sos, zpk2tf)
 from veles.simd_tpu.ops.waveforms import (  # noqa: F401
     chirp, gausspulse, sawtooth, square)
 from veles.simd_tpu.ops.lti import (  # noqa: F401

@@ -76,12 +76,10 @@ def cross_correlate2D(x, h, *, mode: str = "full",
     center for even sizes, scipy's own convention). Leading axes of
     ``x`` are batch."""
     impl = resolve_impl(impl)
-    from veles.simd_tpu.ops.convolve import convolve2D
+    from veles.simd_tpu.ops.convolve import _mode_slice2d, convolve2D
 
     if np.ndim(h) != 2:
         raise ValueError(f"h must be 2-D; got shape {np.shape(h)}")
-    from veles.simd_tpu.ops.convolve import _mode_slice2d
-
     hw = np.shape(x)[-2:]
     kk = np.shape(h)
     if impl == "reference":  # full-precision taps for the f64 oracle

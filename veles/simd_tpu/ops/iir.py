@@ -317,6 +317,14 @@ remez = _design_passthrough("remez", _USE_FIR)
 firls = _design_passthrough("firls", _USE_FIR)
 firwin2 = _design_passthrough("firwin2", _USE_FIR)
 minimum_phase = _design_passthrough("minimum_phase", _USE_FIR)
+_USE_PF = "partial-fraction expansion/recomposition of (b, a) terms."
+residue = _design_passthrough("residue", _USE_PF)
+residuez = _design_passthrough("residuez", _USE_PF)
+invres = _design_passthrough("invres", _USE_PF)
+invresz = _design_passthrough("invresz", _USE_PF)
+unique_roots = _design_passthrough(
+    "unique_roots", "root-list grouping (nearly-equal roots) for the "
+    "partial-fraction family; takes roots, not (b, a).")
 kaiserord = _design_passthrough(
     "kaiserord", "Kaiser estimator; returns (numtaps, beta) for firwin.")
 kaiser_beta = _design_passthrough("kaiser_beta", _USE_PARAM)

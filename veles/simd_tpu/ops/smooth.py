@@ -143,20 +143,45 @@ def savgol_coeffs(window_length, polyorder, deriv=0, delta=1.0):
     return _coeffs(window_length, polyorder, deriv=deriv, delta=delta)
 
 
+@functools.lru_cache(maxsize=64)
+def _savgol_edge_projections(window_length, polyorder, deriv, delta):
+    """(P_left, P_right): scipy's mode="interp" edge refit as two
+    precomputed (halflen, window_length) linear maps — the polynomial
+    fit is linear in the window samples, so edge values are one small
+    matmul (host float64 design, like the center taps)."""
+    wl, halflen = window_length, window_length // 2
+    t = np.arange(wl, dtype=np.float64)
+    vander = np.vander(t, polyorder + 1, increasing=True)
+    fit = np.linalg.pinv(vander)  # (polyorder+1, wl): x_window -> coeffs
+    # derivative operator on increasing-power coefficients
+    coeffs_n = polyorder + 1
+    der = np.eye(coeffs_n)
+    for _ in range(deriv):
+        d = np.zeros((coeffs_n, coeffs_n))
+        for p in range(1, coeffs_n):
+            d[p - 1, p] = p
+        der = d @ der
+    def eval_at(idx):
+        v = np.vander(idx.astype(np.float64), coeffs_n, increasing=True)
+        return v @ der @ fit / (delta ** deriv)
+    p_left = eval_at(np.arange(halflen))
+    p_right = eval_at(np.arange(wl - halflen, wl))
+    return (p_left.astype(np.float32), p_right.astype(np.float32))
+
+
 def savgol_filter(x, window_length, polyorder, *, deriv=0, delta=1.0,
-                  mode="mirror", impl=None):
+                  mode="interp", impl=None):
     """Savitzky-Golay smoothing/differentiation over the last axis:
     least-squares polynomial fit per window, evaluated (or
     differentiated ``deriv`` times) at the center — one FIR correlation
     with host-designed taps.
 
-    ``mode`` maps to a pad policy in {"mirror", "nearest", "wrap",
-    "constant"} (scipy spellings). scipy's default ``mode="interp"``
-    (edge polynomial refit) is intentionally not offered: it is a
-    per-edge least-squares solve, host logic rather than a kernel —
-    use ``mode="mirror"`` (the default here) for near-identical
-    interior behavior; edges then follow the reflect policy on both
-    sides (oracle-matched, scipy supports the same mode).
+    ``mode`` follows scipy exactly: ``"interp"`` (the default, scipy's
+    too) refits a polynomial over each edge window and evaluates it for
+    the first/last ``window_length//2`` samples — linear in the
+    samples, so it runs as two precomputed small matmuls; the pad
+    policies {"mirror", "nearest", "wrap", "constant"} behave as in
+    scipy.
     """
     window_length = int(window_length)
     if window_length < 1 or window_length % 2 == 0:
@@ -164,16 +189,30 @@ def savgol_filter(x, window_length, polyorder, *, deriv=0, delta=1.0,
                          f"got {window_length}")
     if polyorder >= window_length:
         raise ValueError("polyorder must be < window_length")
-    if mode not in _PAD_MODES:
-        raise ValueError(f"mode must be one of {sorted(_PAD_MODES)}, "
-                         f"got {mode!r}")
+    if mode != "interp" and mode not in _PAD_MODES:
+        raise ValueError(f"mode must be 'interp' or one of "
+                         f"{sorted(_PAD_MODES)}, got {mode!r}")
+    if mode == "interp" and np.shape(x)[-1] < window_length:
+        raise ValueError("mode='interp' needs the signal at least as "
+                         "long as window_length (scipy's constraint)")
     if resolve_impl(impl) == "reference":
         return _ref.savgol_filter(x, window_length, polyorder,
                                   deriv=deriv, delta=delta, mode=mode)
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(savgol_coeffs(window_length, polyorder, deriv=deriv,
                                   delta=delta), jnp.float32)
-    return _savgol_xla(x, h, _PAD_MODES[mode])
+    if mode != "interp":
+        return _savgol_xla(x, h, _PAD_MODES[mode])
+    y = _savgol_xla(x, h, "constant")  # interior; edges replaced below
+    p_left, p_right = _savgol_edge_projections(
+        window_length, int(polyorder), int(deriv), float(delta))
+    halflen = window_length // 2
+    left = jnp.einsum("en,...n->...e", jnp.asarray(p_left),
+                      x[..., :window_length])
+    right = jnp.einsum("en,...n->...e", jnp.asarray(p_right),
+                       x[..., -window_length:])
+    return jnp.concatenate(
+        [left, y[..., halflen:y.shape[-1] - halflen], right], axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("pad_mode",))

@@ -21,7 +21,7 @@ def medfilt(x, kernel_size):
 
 
 def savgol_filter(x, window_length, polyorder, deriv=0, delta=1.0,
-                  mode="mirror"):
+                  mode="interp"):
     from scipy.signal import savgol_filter as _savgol
 
     return _savgol(np.asarray(x, np.float64), window_length, polyorder,
