@@ -14,6 +14,9 @@ Run:  python tools/tpu_smoke.py
 """
 
 import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

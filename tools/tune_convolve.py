@@ -26,6 +26,11 @@ reshape/concat block extraction — the gather formulation is 9x slower):
 Run on a TPU host:  python tools/tune_convolve.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 

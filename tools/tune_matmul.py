@@ -13,6 +13,9 @@ Run on a TPU host:  python tools/tune_matmul.py [N]
 """
 
 import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -26,11 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import Element as _Element
 from jax.experimental.pallas import tpu as pltpu
 
 from veles.simd_tpu.pallas import use_interpret
-from veles.simd_tpu.pallas.wavelet import _LANES, _pad_to, _tile
+from veles.simd_tpu.pallas.wavelet import (
+    _LANES, _halo_spec, _pad_batch, _pad_to, _round_halo, _tile)
 
 
 def _fir_kernel(x_ref, taps_ref, o_ref, *, order, out_len):
@@ -49,22 +49,23 @@ def _fir_call(x_pad, taps, order, out_length):
     x2 = x_pad.reshape(batch, x_pad.shape[-1])
 
     bb, bl = _tile(batch, max(out_length, _LANES))
+    halo_pad = _round_halo(halo)
     out_len = -(-out_length // bl) * bl
-    x2 = _pad_to(x2, out_len + halo)
+    x2 = _pad_batch(_pad_to(x2, out_len + halo_pad), bb)
+    pb = x2.shape[0]
     kernel = functools.partial(_fir_kernel, order=order, out_len=bl)
     out = pl.pallas_call(
         kernel,
-        grid=(batch // bb, out_len // bl),
-        in_specs=[pl.BlockSpec((bb, _Element(bl + halo, (0, 0))),
-                               lambda i, j: (i, j * bl)),
+        grid=(pb // bb, out_len // bl),
+        in_specs=[_halo_spec(bb, bl, halo_pad, pb // bb),
                   pl.BlockSpec((1, order), lambda i, j: (0, 0))],
         out_specs=pl.BlockSpec((bb, bl), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((batch, out_len), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((pb, out_len), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=use_interpret(),
     )(x2, taps.reshape(1, order))
-    return out[:, :out_length].reshape(lead + (out_length,))
+    return out[:batch, :out_length].reshape(lead + (out_length,))
 
 
 def convolve_direct(x, h, *, reverse=False):

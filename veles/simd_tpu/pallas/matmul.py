@@ -44,9 +44,13 @@ def _make_kernel(transpose_b, f32_product):
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
         if f32_product:
-            # precision="float32": feed the dot full-width operands and
-            # let Mosaic emit the multi-pass f32 product (the in-kernel
-            # analogue of XLA precision=HIGHEST; ~half MXU rate).
+            # precision="float32": feed the dot full-width operands AND
+            # force Precision.HIGHEST — full-width refs alone are not
+            # enough (Mosaic still emits the single-pass bf16 product at
+            # default precision; measured on-chip, 99% of elements off at
+            # rtol 2e-5). HIGHEST selects the multi-pass f32 product
+            # (~1/6 MXU rate), the in-kernel analogue of impl="xla" with
+            # precision="highest".
             x_blk, y_blk = x_ref[:], y_ref[:]
         else:
             # Explicit bf16 operands: a float32 dot inside Mosaic lowers
@@ -58,7 +62,8 @@ def _make_kernel(transpose_b, f32_product):
             x_blk = x_ref[:].astype(jnp.bfloat16)
             y_blk = y_ref[:].astype(jnp.bfloat16)
         acc_ref[:] += jax.lax.dot_general(
-            x_blk, y_blk, contract, preferred_element_type=jnp.float32)
+            x_blk, y_blk, contract, preferred_element_type=jnp.float32,
+            precision=(jax.lax.Precision.HIGHEST if f32_product else None))
 
         @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
         def _flush():

@@ -23,7 +23,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from veles.simd_tpu.pallas import use_interpret
-from veles.simd_tpu.pallas.wavelet import _LANES, _tile
+from veles.simd_tpu.pallas.wavelet import _LANES, _pad_batch, _tile
 
 
 def _minmax_kernel(x_ref, min_ref, max_ref, acc_min, acc_max):
@@ -51,18 +51,20 @@ def _minmax_call(x2):
         # pad with the first sample of each row: never affects min/max
         x2 = jnp.concatenate(
             [x2, jnp.broadcast_to(x2[:, :1], (batch, padded_n - n))], axis=1)
+    x2 = _pad_batch(x2, bb)  # padded rows reduce to (0, 0), sliced off
+    pb = x2.shape[0]
     vmin, vmax = pl.pallas_call(
         _minmax_kernel,
-        grid=(batch // bb, padded_n // bl),
+        grid=(pb // bb, padded_n // bl),
         in_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))],
         out_specs=[pl.BlockSpec((bb, 1), lambda i, j: (i, 0))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((batch, 1), jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct((pb, 1), jnp.float32)] * 2,
         scratch_shapes=[pltpu.VMEM((bb, 1), jnp.float32)] * 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=use_interpret(),
     )(x2)
-    return vmin, vmax
+    return vmin[:batch], vmax[:batch]
 
 
 def minmax1D(x):

@@ -58,13 +58,51 @@ def _pad_to(x, length):
 
 
 def _tile(batch, out_len):
-    """Pick (bb, bl) grid tiles: bb batch rows x bl output samples."""
+    """Pick (bb, bl) grid tiles: bb batch rows x bl output samples.
+
+    Mosaic requires the sublane block dim to be a multiple of 8 or the
+    whole array dim, so bb is always 8 for batch >= 8 and callers pad the
+    batch rows up to a bb multiple (`_pad_batch`) rather than hunting for
+    an exact divisor."""
     bb = min(batch, _SUBLANES)
-    while batch % bb:
-        bb -= 1
     bl = min(out_len, max(_LANES, _BLOCK_ELEMS // bb))
     bl = max(_LANES, bl - bl % _LANES)
     return bb, bl
+
+
+def _pad_batch(x2, bb):
+    """Pad leading (batch) rows up to a multiple of the bb grid tile."""
+    pad = -x2.shape[0] % bb
+    if pad:
+        x2 = jnp.pad(x2, [(0, pad), (0, 0)])
+    return x2
+
+
+def _halo_spec(bb, bl, halo_pad, n_batch_blocks=1):
+    """Overlapping input windows as an all-Element BlockSpec.
+
+    Mosaic's element-indexed lowering has three hard constraints the CPU
+    interpreter never checks (all hit on first chip contact): a spec may
+    not mix Blocked and Element dims, the lane-dim block size must be a
+    multiple of 128, and every element offset must be *provably*
+    divisible by the chosen register tiling (a stride-3 batch offset
+    under a (4, 128) tile is rejected even when the grid only ever
+    produces offset 0). So the batch dim is Element too, a single batch
+    block emits a literal-0 offset (always provable; multi-block grids
+    use stride bb, which `_tile` keeps at the full 8-sublane group), and
+    the halo is rounded up to a whole 128-lane group — the kernel's
+    static tap offsets stay < the true halo and the extra tail lanes are
+    dead reads of padding."""
+    if n_batch_blocks == 1:
+        index = lambda i, j: (0, j * bl)  # noqa: E731
+    else:
+        index = lambda i, j: (i * bb, j * bl)  # noqa: E731
+    return pl.BlockSpec(
+        (_Element(bb, (0, 0)), _Element(bl + halo_pad, (0, 0))), index)
+
+
+def _round_halo(halo):
+    return -(-halo // _LANES) * _LANES if halo else 0
 
 
 def _dwt_kernel(even_ref, odd_ref, hi_ref, lo_ref, *, taps_hi, taps_lo,
@@ -105,28 +143,29 @@ def _dwt_call(x_ext, taps_hi, taps_lo):
     x2 = x_ext.reshape(batch, x_ext.shape[-1])
 
     bb, bl = _tile(batch, max(half, _LANES))
+    halo_pad = _round_halo(halo)
     out_len = -(-half // bl) * bl  # half rounded up to a whole block grid
-    in_len = out_len + halo
+    in_len = out_len + halo_pad
     # De-interleave into phase planes: x[2d + 2k] = even[d+k],
     # x[2d + 2k + 1] = odd[d+k].
-    even = _pad_to(_lane_phase(x2, 0), in_len)
-    odd = _pad_to(_lane_phase(x2, 1), in_len)
+    even = _pad_batch(_pad_to(_lane_phase(x2, 0), in_len), bb)
+    odd = _pad_batch(_pad_to(_lane_phase(x2, 1), in_len), bb)
     kernel = functools.partial(_dwt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
                                out_len=bl)
-    in_spec = pl.BlockSpec((bb, _Element(bl + halo, (0, 0))),
-                           lambda i, j: (i, j * bl))
+    pb = even.shape[0]
+    in_spec = _halo_spec(bb, bl, halo_pad, pb // bb)
     hi, lo = pl.pallas_call(
         kernel,
-        grid=(batch // bb, out_len // bl),
+        grid=(pb // bb, out_len // bl),
         in_specs=[in_spec, in_spec],
         out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((batch, out_len), jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct((pb, out_len), jnp.float32)] * 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=use_interpret(),
     )(even, odd)
-    return hi[:, :half].reshape(lead + (half,)), \
-        lo[:, :half].reshape(lead + (half,))
+    return hi[:batch, :half].reshape(lead + (half,)), \
+        lo[:batch, :half].reshape(lead + (half,))
 
 
 def dwt_filter_bank(x_ext, hi_taps, lo_taps):
@@ -163,23 +202,24 @@ def _swt_call(x_ext, taps_hi, taps_lo, stride, out_length):
     x2 = x_ext.reshape(batch, x_ext.shape[-1])
 
     bb, bl = _tile(batch, max(out_length, _LANES))
+    halo_pad = _round_halo(halo)
     out_len = -(-out_length // bl) * bl
-    x2 = _pad_to(x2, out_len + halo)
+    x2 = _pad_batch(_pad_to(x2, out_len + halo_pad), bb)
+    pb = x2.shape[0]
     kernel = functools.partial(_swt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
                                stride=stride, out_len=bl)
     hi, lo = pl.pallas_call(
         kernel,
-        grid=(batch // bb, out_len // bl),
-        in_specs=[pl.BlockSpec((bb, _Element(bl + halo, (0, 0))),
-                               lambda i, j: (i, j * bl))],
+        grid=(pb // bb, out_len // bl),
+        in_specs=[_halo_spec(bb, bl, halo_pad, pb // bb)],
         out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((batch, out_len), jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct((pb, out_len), jnp.float32)] * 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=use_interpret(),
     )(x2)
-    return hi[:, :out_length].reshape(lead + (out_length,)), \
-        lo[:, :out_length].reshape(lead + (out_length,))
+    return hi[:batch, :out_length].reshape(lead + (out_length,)), \
+        lo[:batch, :out_length].reshape(lead + (out_length,))
 
 
 def swt_filter_bank(x_ext, hi_taps, lo_taps, stride, out_length):
