@@ -129,6 +129,21 @@ class TestIirStream:
         want = np.asarray(ops.sosfilt(x, sos))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
+    def test_unbatched_state_broadcasts_over_batched_chunk(self, rng):
+        # an (n_sections, 2) state from the default iir_stream_init()
+        # must broadcast across a batched chunk (regression: the r3
+        # time-leading rewrite briefly reshaped the state without
+        # broadcasting first, raising from inside jit)
+        x = rng.normal(size=(2, 300)).astype(np.float32)
+        sos = _sos(3, 0.25)
+        st = ops.iir_stream_init(sos)  # batch_shape=()
+        st2, y = ops.iir_stream_step(st, x, sos)
+        assert y.shape == x.shape
+        assert st2.state.shape == (2, sos.shape[0], 2)
+        want = np.asarray(ops.sosfilt(x, sos))
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
+                                   atol=1e-4)
+
     def test_state_shape_contract(self):
         sos = _sos(4, 0.2)
         st = ops.iir_stream_init(sos)

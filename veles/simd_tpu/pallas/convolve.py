@@ -49,6 +49,15 @@ def _fir_call(x_pad, taps, order, out_length):
     x2 = x_pad.reshape(batch, x_pad.shape[-1])
 
     bb, bl = _tile(batch, max(out_length, _LANES))
+    # Unlike the wavelet kernels (whose taps are trace-time constants
+    # Mosaic folds into the mul-add chain), each of the `order` runtime
+    # taps holds a live (bb, bl) f32 temporary on the kernel's VMEM
+    # stack: measured on-chip, m=127 at bl=65536 allocates 25.3 MB of
+    # scoped stack against the 16 MB limit and is rejected. Cap the
+    # block so order * bb * bl stays within a ~4 MB stack budget.
+    stack_elems = 1 << 20
+    bl = min(bl, max(_LANES, (stack_elems // (bb * max(order, 1)))
+                     // _LANES * _LANES))
     halo_pad = _round_halo(halo)
     out_len = -(-out_length // bl) * bl
     x2 = _pad_batch(_pad_to(x2, out_len + halo_pad), bb)
