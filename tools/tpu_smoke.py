@@ -66,8 +66,11 @@ def main():
         np.testing.assert_allclose(gt, want, rtol=2e-5, atol=2e-4)
 
     def dwt():
+        # (3, 65536) = 196k samples: above _PALLAS_DWT_MIN (the op-level
+        # dispatch delegates smaller calls to the XLA bank), odd batch
+        # exercises the literal-0 single-batch-block offset path
         from veles.simd_tpu import ops
-        x = rng.normal(size=(3, 4096)).astype(np.float32)
+        x = rng.normal(size=(3, 65536)).astype(np.float32)
         hi_p, lo_p = ops.wavelet_apply(x, "daubechies", 8, impl="pallas")
         hi_x, lo_x = ops.wavelet_apply(x, "daubechies", 8, impl="xla")
         np.testing.assert_allclose(np.asarray(hi_p), np.asarray(hi_x),

@@ -156,6 +156,14 @@ def _check(src, wavelet_type, order, decimated):
         raise ValueError(f"signal length {n} must be even and positive")
 
 
+# impl="pallas" size floor for the decimated bank: below this many total
+# samples the hand kernel's phase-plane materializations + grid launch
+# cost more than the whole level, and the XLA fused bank runs instead
+# (measured r3 on-chip; per-level the kernel ties or beats XLA from
+# ~128k samples up, chip-state drift ~1.2x either way)
+_PALLAS_DWT_MIN = 128 * 1024
+
+
 def wavelet_apply(src, wavelet_type="daubechies", order=8,
                   ext=EXTENSION_PERIODIC, *, impl=None):
     """One decimated DWT step -> (desthi, destlo), each length n/2.
@@ -169,10 +177,16 @@ def wavelet_apply(src, wavelet_type="daubechies", order=8,
     src = jnp.asarray(src, jnp.float32)
     _check(src, wavelet_type, order, decimated=True)
     hi, lo = wavelet_data.highpass_lowpass(wavelet_type, order, np.float32)
-    if impl == "pallas":
+    if impl == "pallas" and src.size >= _PALLAS_DWT_MIN:
         from veles.simd_tpu.pallas.wavelet import dwt_filter_bank
         # batch-native: leading dims become a kernel grid dimension
         return dwt_filter_bank(_extend(src, order, ext), hi, lo)
+    # impl="pallas" below the threshold delegates to the XLA bank: the
+    # hand kernel's pad/phase-plane materializations and grid launch are
+    # pure overhead on small arrays, where XLA's single fused shift-add
+    # kernel owns the level (r3 on-chip: the 6-level bench leg spends
+    # its last three levels under 64k samples). Mirrors the dispatch
+    # idiom of ops.convolve's algorithm selector.
     filters = jnp.asarray(np.stack([hi, lo]))
     return _wavelet_apply_xla(src, filters, ext)
 

@@ -118,7 +118,7 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True,
     )(x, y)
 
 
-def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512,
+def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=1024,
            stream_bf16=True, precision=None):
     """x @ y (or x @ y.T) via the tiled Pallas kernel; shapes zero-padded.
 
@@ -131,11 +131,22 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=512,
     satisfy (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the
     ~16 MB scoped VMEM budget including double buffers, or the kernel
     fails to allocate. Defaults from the on-chip sweep
-    (tools/tune_matmul.py)."""
+    (tools/tune_matmul.py, r3 2026-07-31: 512x1024x1024 bf16io measured
+    174.8 TFLOPS = 1.093x dot_general at N=4096; the prior 512x1024x512
+    default measured 170.5 = 1.066x; all 1024x1024+ tiles exceed
+    VMEM)."""
     if precision not in (None, "bf16", "float32"):
         raise ValueError(
             f"precision must be None, 'bf16' or 'float32', got {precision!r}")
     f32_product = precision == "float32"
+    if f32_product:
+        # the r3 sweep measured bf16-streamed tiles only; full-width f32
+        # blocks blow the 16 MB scoped budget at the streamed defaults
+        # (measured on-chip: 512x1024x512 f32 allocates 16.21 MB —
+        # 216 KB over). Clamp this path to 512^3 tiles (~8 MB with
+        # double buffers), VMEM-validated at 2048^2 on the chip.
+        bn = min(bn, 512)
+        bk = min(bk, 512)
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     inner = y.shape[-1] if transpose_b else y.shape[0]

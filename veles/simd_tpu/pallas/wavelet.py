@@ -168,6 +168,22 @@ def _dwt_call(x_ext, taps_hi, taps_lo):
         lo[:batch, :half].reshape(lead + (half,))
 
 
+# NOTE (r3, measured): a single-HBM-pass variant that deinterleaves
+# INSIDE the kernel (reading the raw extended signal, shuffling to
+# even/odd in VMEM) would remove the phase-plane materialization that
+# pallas_call's fusion barrier forces and lift the leg's ~0.5x HBM
+# ceiling vs the fused XLA bank. Every available formulation of the
+# in-kernel stride-2 shuffle fails to lower through this Mosaic
+# version, each verified on-chip: 3-D `reshape(bb, w//256, 256)[:, :,
+# 0::2]` -> "Only 2D gather is supported"; the 2-D rows form -> "Shape
+# mismatch in input, indices and output"; `reshape(bb, w//2, 2)[:, :,
+# 0]` -> compile-helper crash; `lax.slice` with stride 2 ->
+# 'vector.extract_strided_slice' verification error. Until Mosaic
+# grows a lane deinterleave, the two-plane kernel below is the hand
+# leg, and ops.wavelet delegates small levels to the XLA bank
+# (_PALLAS_DWT_MIN).
+
+
 def dwt_filter_bank(x_ext, hi_taps, lo_taps):
     """Decimated filter bank over an already-extended signal.
 

@@ -106,9 +106,12 @@ def bench_convolve(scale=1):
         from veles.simd_tpu.pallas.convolve import convolve_direct
         return convolve_direct(c, h)[:n]
 
+    # 8192 iters: the direct shift-add chain at 1024 steps measured
+    # inside the RTT floor on the r3 chip run (direct_shift_msps=None
+    # while slower legs resolved) — ~16 us/step needs a longer chain
     sts = chain_stats({"os": step_os, "direct": step_direct,
                        "direct_pallas": step_direct_pallas},
-                      x, iters=1024, on_floor="nan")
+                      x, iters=8192, on_floor="nan")
     # headline value = best PRODUCTION path (what ops.convolve's selector
     # can actually deliver); the opt-in hand kernel reports on the side
     prod = [sts[k] for k in ("os", "direct") if sts[k]["sec"] == sts[k]["sec"]]
