@@ -135,19 +135,25 @@ def _sosfilt_xla(x, sos, s0, n_sections, chunk=0):
     s0f = jnp.broadcast_to(s0, lead + (n_sections, 2)).reshape(
         batch, n_sections, 2)
     use_chunked = chunk and n > chunk
-    finals = []
-    yT = xT
-    for k in range(n_sections):
-        coeffs = (sos[k, 0], sos[k, 1], sos[k, 2], sos[k, 4], sos[k, 5])
-        z1_0, z2_0 = s0f[:, k, 0], s0f[:, k, 1]
+
+    # cascade via lax.scan over the section axis: the per-section scan
+    # tree is compiled ONCE, not inlined per section (a Python loop over
+    # 6 sections measured 15 s of CPU compile for the flat tree alone;
+    # runtime is identical — 6 carried iterations of the same program)
+    def cascade_body(yT, per):
+        cf, z0k = per  # (6,) sos row, (batch, 2) incoming state
+        coeffs = (cf[0], cf[1], cf[2], cf[4], cf[5])
         if use_chunked:
-            yT, z1f, z2f = _section_scan_chunked_T(yT, coeffs, z1_0, z2_0,
-                                                   chunk)
+            yT, z1f, z2f = _section_scan_chunked_T(yT, coeffs, z0k[:, 0],
+                                                   z0k[:, 1], chunk)
         else:
-            yT, z1f, z2f = _section_scan_T(yT, coeffs, z1_0, z2_0)
-        finals.append(jnp.stack([z1f, z2f], axis=-1))  # (batch, 2)
+            yT, z1f, z2f = _section_scan_T(yT, coeffs, z0k[:, 0], z0k[:, 1])
+        return yT, jnp.stack([z1f, z2f], axis=-1)
+
+    yT, finals = jax.lax.scan(cascade_body, xT,
+                              (sos, jnp.moveaxis(s0f, 1, 0)))
     y = yT.T.reshape(lead + (n,))
-    s_fin = jnp.stack(finals, axis=-2).reshape(lead + (n_sections, 2))
+    s_fin = jnp.moveaxis(finals, 0, 1).reshape(lead + (n_sections, 2))
     return y, s_fin
 
 

@@ -16,7 +16,10 @@ The reference's order-specialized kernels (wavelet_apply2..16 dispatched at
 src/wavelet.c:1877-1939) collapse into shape specialization: jit re-
 specializes per (order, length, extension), which is exactly what the hand
 dispatch table did. The `impl="pallas"` path runs the fused VPU filter-bank
-kernels in pallas/wavelet.py.
+kernels in pallas/wavelet.py for decimated calls of at least
+`_PALLAS_DWT_MIN` total samples and delegates smaller calls to the XLA
+bank (the kernel's phase-plane materialization is pure overhead below
+that size — measured waiver in docs/parity.md).
 
 Boundary handling: the 4 extension modes of initialize_extension
 (src/wavelet.c:247-268) as functional right-padding. High-pass filters are
@@ -170,6 +173,10 @@ def wavelet_apply(src, wavelet_type="daubechies", order=8,
 
     Parity: wavelet_apply (src/wavelet.c:1877-1904). Accepts leading batch
     dimensions (the reference is strictly 1-D; batching is the TPU axis).
+    ``impl="pallas"`` dispatches the hand kernel only at >=
+    ``_PALLAS_DWT_MIN`` (128k) total samples; below that it runs the XLA
+    bank, which is faster there (measured r3 waiver, docs/parity.md) —
+    call ``pallas.wavelet.dwt_filter_bank`` directly to force the kernel.
     """
     impl = resolve_impl(impl)
     if impl == "reference":

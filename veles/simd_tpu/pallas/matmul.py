@@ -139,16 +139,18 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=1024,
         raise ValueError(
             f"precision must be None, 'bf16' or 'float32', got {precision!r}")
     f32_product = precision == "float32"
-    if f32_product:
-        # the r3 sweep measured bf16-streamed tiles only; full-width f32
-        # blocks blow the 16 MB scoped budget at the streamed defaults
-        # (measured on-chip: 512x1024x512 f32 allocates 16.21 MB —
-        # 216 KB over). Clamp this path to 512^3 tiles (~8 MB with
-        # double buffers), VMEM-validated at 2048^2 on the chip.
-        bn = min(bn, 512)
-        bk = min(bk, 512)
     x = jnp.asarray(x)
     y = jnp.asarray(y)
+    if f32_product or (not stream_bf16 and x.dtype == jnp.float32):
+        # the r3 sweep measured bf16-streamed tiles only; any path whose
+        # blocks travel HBM->VMEM at full f32 width (precision="float32"
+        # or stream_bf16=False on f32 inputs) blows the 16 MB scoped
+        # budget at the streamed defaults (measured on-chip:
+        # 512x1024x512 f32 allocates 16.21 MB — 216 KB over). Clamp
+        # those paths to 512^3 tiles (~8 MB with double buffers),
+        # VMEM-validated at 2048^2 on the chip.
+        bn = min(bn, 512)
+        bk = min(bk, 512)
     inner = y.shape[-1] if transpose_b else y.shape[0]
     if x.ndim != 2 or y.ndim != 2 or x.shape[1] != inner:
         op = "@T" if transpose_b else "@"
