@@ -98,6 +98,11 @@ def bench_matmul_4096():
         "pallas_attempts": [gflops(s)
                             for s in sts["pallas"].get("attempt_sec", [])],
     }
+    # a leg that failed to compile/run carries its reason into the
+    # artifact — a null rate alone is indistinguishable from a floored
+    # measurement (benchlib failed-leg isolation, r3)
+    from veles.simd_tpu.utils.bench_extra import _attach_leg_errors
+    _attach_leg_errors(result, sts)
     if xla_g and pallas_g:
         result["pallas_vs_xla"] = round(pallas_g / xla_g, 3)
     return result

@@ -31,9 +31,17 @@ def test_pytree_carry():
 
 
 def test_non_finite_checksum_raises():
+    # failed-leg isolation (r3): chain_stats records the reason per leg;
+    # strict (on_floor="raise") chain_time callers still get the loud
+    # failure, with the reason in the message
     with pytest.raises(RuntimeError, match="non-finite"):
         chain_time(lambda c: c * jnp.float32(2.0),
                    jnp.full(4, 1e30, jnp.float32), iters=64, reps=1)
+    from veles.simd_tpu.utils.benchlib import chain_stats
+    sts = chain_stats({"_": lambda c: c * jnp.float32(2.0)},
+                      jnp.full(4, 1e30, jnp.float32), iters=64, reps=1,
+                      on_floor="nan")
+    assert "non-finite" in sts["_"]["error"]
 
 
 @pytest.mark.skipif(os.environ.get("VELES_TEST_TPU") == "1",

@@ -6,6 +6,12 @@ import pytest
 
 from veles.simd_tpu import ops
 
+# Ricker-path tests run on the real TPU too: the wavelet bank ships as
+# real/imag float32 pairs (ops/cwt.py), so only tests that read back or
+# upload a COMPLEX array itself (morlet2 output, analytic input) carry
+# the native_complex gate (the axon tunnel lacks complex64 host<->device
+# transfer, and one failed transfer poisons the backend process).
+
 
 class TestWaveletTaps:
     def test_ricker_admissibility(self):
@@ -25,7 +31,9 @@ class TestWaveletTaps:
 
 
 class TestCwt:
-    @pytest.mark.parametrize("wavelet", ["ricker", "morlet2"])
+    @pytest.mark.parametrize("wavelet", [
+        "ricker",
+        pytest.param("morlet2", marks=pytest.mark.native_complex)])
     def test_matches_oracle(self, rng, wavelet):
         x = rng.normal(size=256).astype(np.float32)
         scales = (1.0, 3.0, 7.5, 20.0)
@@ -52,6 +60,7 @@ class TestCwt:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @pytest.mark.native_complex
     def test_ridge_tracks_tone_scale(self):
         """Scalogram physics: a pure tone's energy ridge sits at the
         scale whose morlet2 center frequency matches the tone."""
@@ -90,6 +99,7 @@ class TestCwt:
             ops.cwt(x, ())
 
 
+@pytest.mark.native_complex
 def test_complex_input_supported(rng):
     """Analytic/IQ input keeps its imaginary part (review r3 finding):
     CWT is linear, so cwt(hilbert(x)) == cwt(x) + 1j*cwt(imag part)."""

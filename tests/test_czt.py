@@ -5,8 +5,16 @@ import pytest
 
 from veles.simd_tpu import ops
 
+# czt/zoom_fft OUTPUTS are complex64, so every test that reads a
+# spectrum back carries the native_complex gate (the axon tunnel lacks
+# complex64 host<->device transfer and one failed transfer poisons the
+# backend process); pure host-side contract tests stay ungated. The op
+# itself computes on-device (constants ride as real/imag pairs).
+_needs_complex_readback = pytest.mark.native_complex
+
 
 class TestCzt:
+    @_needs_complex_readback
     def test_default_is_dft(self, rng):
         """czt with defaults equals the FFT (scipy's invariant)."""
         x = rng.normal(size=128).astype(np.float32)
@@ -16,6 +24,7 @@ class TestCzt:
 
     @pytest.mark.parametrize("n,m", [(100, 100), (128, 37), (64, 200),
                                      (257, 129)])
+    @_needs_complex_readback
     def test_matches_scipy_unit_circle(self, rng, n, m):
         x = rng.normal(size=n).astype(np.float32)
         w = np.exp(-2j * np.pi * 0.9 / m)
@@ -25,6 +34,7 @@ class TestCzt:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @_needs_complex_readback
     def test_off_circle_spiral(self, rng):
         """|w| != 1: the z-plane spiral (damped-resonance probing)."""
         x = rng.normal(size=64).astype(np.float32)
@@ -34,6 +44,7 @@ class TestCzt:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @_needs_complex_readback
     def test_batched(self, rng):
         x = rng.normal(size=(3, 4, 96)).astype(np.float32)
         want = ops.czt(x, m=50, impl="reference")
@@ -41,6 +52,7 @@ class TestCzt:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @_needs_complex_readback
     def test_large_m_phase_stability(self, rng):
         """The reason chirps precompute host-side in f64: k^2/2 phases
         overflow f32 precision around k ~ 1400; a 4096-point czt must
@@ -59,6 +71,7 @@ class TestCzt:
 
 
 class TestZoomFft:
+    @_needs_complex_readback
     def test_matches_scipy(self, rng):
         x = rng.normal(size=512).astype(np.float32)
         want = ops.zoom_fft(x, (0.1, 0.3), m=200, impl="reference")
@@ -66,6 +79,7 @@ class TestZoomFft:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @_needs_complex_readback
     def test_scalar_band(self, rng):
         x = rng.normal(size=256).astype(np.float32)
         want = ops.zoom_fft(x, 0.5, m=64, impl="reference")
@@ -73,6 +87,7 @@ class TestZoomFft:
         scale = np.abs(want).max()
         np.testing.assert_allclose(got / scale, want / scale, atol=2e-5)
 
+    @_needs_complex_readback
     def test_resolves_close_tones(self):
         """The op's purpose: two tones 0.0005 apart (below the 1/n FFT
         grid) separate in a zoomed band."""

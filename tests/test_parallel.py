@@ -347,6 +347,7 @@ class TestLombscargleSharded:
                 weights=np.ones(49))
 
 
+@pytest.mark.native_complex
 class TestCwtSharded:
     def test_matches_single_device(self, rng):
         m = parallel.make_mesh({"scale": 8})

@@ -61,6 +61,7 @@ def test_fourier_resample_random(seed):
                                err_msg=f"seed={seed} {n}->{num}")
 
 
+@pytest.mark.native_complex
 @pytest.mark.parametrize("seed", range(8))
 def test_czt_random_spirals(seed):
     g = np.random.default_rng(7300 + seed)
@@ -84,6 +85,7 @@ def test_czt_random_spirals(seed):
                                err_msg=f"seed={seed} n={n} m={m}")
 
 
+@pytest.mark.native_complex
 @pytest.mark.parametrize("seed", range(6))
 def test_cwt_random_scales(seed):
     from veles.simd_tpu.reference import cwt as ref_cwt

@@ -68,23 +68,34 @@ def _bank_fft(wavelet_name, scales, n, w, full_fft):
         if s:
             bank[i, L - s:] = h[:s]
     if is_complex:
-        bank_f = np.fft.fft(bank, axis=-1).astype(np.complex64)
+        bank_f = np.fft.fft(bank, axis=-1)
     else:
         # real wavelets keep the one-sided spectrum: rfft/irfft halves
         # the FLOPs and the dominant (batch, S, L) workspace
-        bank_f = np.fft.rfft(bank.real, axis=-1).astype(np.complex64)
-    # cache the HOST array: a cached device array materialized inside a
+        bank_f = np.fft.rfft(bank.real, axis=-1)
+    # cache HOST arrays: a cached device array materialized inside a
     # trace (jax.export, jit) would leak that trace's tracer into later
-    # calls; jnp converts it per call and XLA dedups the constant.
-    # Read-only: the same object serves every later identical call.
-    bank_f.setflags(write=False)
-    return bank_f, L, is_complex
+    # calls; jnp converts per call and XLA dedups the constants.
+    # Shipped as a real/imag float32 PAIR, recombined on-device: the
+    # axon tunnel has no complex64 host->device transfer, and one
+    # complex constant upload poisons the whole backend process
+    # (measured r3 — this single constant was what killed every test
+    # after test_export in the hardware suite). Read-only: the same
+    # objects serve every later identical call.
+    bank_re = np.ascontiguousarray(bank_f.real, np.float32)
+    bank_im = np.ascontiguousarray(bank_f.imag, np.float32)
+    bank_re.setflags(write=False)
+    bank_im.setflags(write=False)
+    return bank_re, bank_im, L, is_complex
 
 
 @functools.partial(jax.jit, static_argnames=("L", "n", "mode"))
-def _cwt_xla(x, bank_fft, L, n, mode):
+def _cwt_xla(x, bank_re, bank_im, L, n, mode):
     """mode: 'real' (real signal+wavelet via rfft), 'complex' (either
-    side complex: full FFT, complex output)."""
+    side complex: full FFT, complex output). The bank spectrum arrives
+    as a real/imag float32 pair and becomes complex ON-DEVICE (see
+    _bank_fft on why)."""
+    bank_fft = jax.lax.complex(bank_re, bank_im)
     if mode == "real":
         xf = jnp.fft.rfft(x, n=L, axis=-1)
         return jnp.fft.irfft(xf[..., None, :] * bank_fft, n=L,
@@ -130,8 +141,8 @@ def cwt(x, scales, wavelet="ricker", *, w=5.0, impl=None):
         flat = xr.reshape(-1, n)
         outs = [_ref.cwt(r, fn, scales, **kwargs) for r in flat]
         return np.stack(outs).reshape(xr.shape[:-1] + (len(scales), n))
-    bank_fft, L, is_complex = _bank_fft(wavelet, scales, n, float(w),
-                                        x_complex)
+    bank_re, bank_im, L, is_complex = _bank_fft(wavelet, scales, n,
+                                                float(w), x_complex)
     xj = jnp.asarray(x, jnp.complex64 if x_complex else jnp.float32)
-    return _cwt_xla(xj, bank_fft, L, n,
+    return _cwt_xla(xj, bank_re, bank_im, L, n,
                     "complex" if is_complex else "real")

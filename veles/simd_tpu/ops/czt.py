@@ -10,7 +10,8 @@ the machinery XLA already owns (the same reason the FFT convolve leg
 aliases XLA, docs/parity.md). The chirp phase k^2/2 grows past float32's
 usable range almost immediately (k^2/2 ~ 1e6 at k ~ 1400), so the three
 chirp vectors are precomputed host-side in float64 with phases reduced
-mod 2*pi, then shipped to the device as complex64 constants — the
+mod 2*pi, then shipped to the device as real/imag float32 pairs and
+recombined on-device (the axon tunnel cannot transfer complex64) — the
 device never evaluates a large-angle transcendental.
 
 ``zoom_fft`` evaluates a dense DFT over just [f1, f2) without computing
@@ -67,13 +68,26 @@ def _chirp_constants(n, m, w, a):
     kern[:m] = iwk2[:m]
     if n > 1:
         kern[L - (n - 1):] = iwk2[1:n][::-1]
-    kern_fft = np.fft.fft(kern).astype(np.complex64)
-    return (an.astype(np.complex64), kern_fft,
-            wk2[:m].astype(np.complex64), L)
+    kern_fft = np.fft.fft(kern)
+    # ship every complex constant as a real/imag float32 pair and
+    # recombine on-device: the axon tunnel cannot transfer complex64
+    # host->device, and one failed upload poisons the backend process
+    # (the r3 cwt-bank lesson; same contract here)
+    def _pair(z):
+        re = np.ascontiguousarray(z.real, np.float32)
+        im = np.ascontiguousarray(z.imag, np.float32)
+        re.setflags(write=False)
+        im.setflags(write=False)
+        return re, im
+
+    return (_pair(an), _pair(kern_fft), _pair(wk2[:m]), L)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "L"))
-def _czt_xla(x, an, kern_fft, post, m, L):
+def _czt_xla(x, an_re, an_im, kern_re, kern_im, post_re, post_im, m, L):
+    an = jax.lax.complex(an_re, an_im)
+    kern_fft = jax.lax.complex(kern_re, kern_im)
+    post = jax.lax.complex(post_re, post_im)
     y = x.astype(jnp.complex64) * an
     yf = jnp.fft.fft(y, n=L, axis=-1)
     conv = jnp.fft.ifft(yf * kern_fft, axis=-1)
@@ -117,9 +131,10 @@ def _czt_impl(x, m, w, a, impl):
     if resolve_impl(impl) == "reference":
         from scipy.signal import czt as _czt
         return _czt(np.asarray(x), m=m, w=w, a=a, axis=-1)
-    an, kern_fft, post, L = _chirp_constants(n, m, w, a)
-    return _czt_xla(jnp.asarray(x), jnp.asarray(an),
-                    jnp.asarray(kern_fft), jnp.asarray(post), m, L)
+    (an_re, an_im), (kern_re, kern_im), (post_re, post_im), L = \
+        _chirp_constants(n, m, w, a)
+    return _czt_xla(jnp.asarray(x), an_re, an_im, kern_re, kern_im,
+                    post_re, post_im, m, L)
 
 
 def zoom_fft(x, fn, m=None, *, fs=2, impl=None):

@@ -118,7 +118,7 @@ def _matmul_padded(x, y, bm, bn, bk, transpose_b=False, stream_bf16=True,
     )(x, y)
 
 
-def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=1024,
+def matmul(x, y, *, transpose_b=False, bm=512, bn=None, bk=None,
            stream_bf16=True, precision=None):
     """x @ y (or x @ y.T) via the tiled Pallas kernel; shapes zero-padded.
 
@@ -130,27 +130,34 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=1024, bk=1024,
     roughly half the MXU rate (and full-width block traffic). Tiles must
     satisfy (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the
     ~16 MB scoped VMEM budget including double buffers, or the kernel
-    fails to allocate. Defaults from the on-chip sweep
-    (tools/tune_matmul.py, r3 2026-07-31: 512x1024x1024 bf16io measured
-    174.8 TFLOPS = 1.093x dot_general at N=4096; the prior 512x1024x512
-    default measured 170.5 = 1.066x; all 1024x1024+ tiles exceed
-    VMEM)."""
+    fails to allocate. ``bn``/``bk`` default per block width (explicit
+    values are honored verbatim): bf16-streamed paths use the r3 swept
+    winner 512x1024x1024 (174.8 TFLOPS = 1.093x dot_general at N=4096;
+    the prior 512x1024x512 default measured 170.5 = 1.066x; 1024x1024+
+    tiles exceed VMEM), full-f32-width paths use 512^3 (the streamed
+    tile's f32 blocks measured 216 KB over the 16 MB scoped budget)."""
     if precision not in (None, "bf16", "float32"):
         raise ValueError(
             f"precision must be None, 'bf16' or 'float32', got {precision!r}")
     f32_product = precision == "float32"
     x = jnp.asarray(x)
     y = jnp.asarray(y)
-    if f32_product or (not stream_bf16 and x.dtype == jnp.float32):
-        # the r3 sweep measured bf16-streamed tiles only; any path whose
-        # blocks travel HBM->VMEM at full f32 width (precision="float32"
-        # or stream_bf16=False on f32 inputs) blows the 16 MB scoped
-        # budget at the streamed defaults (measured on-chip:
-        # 512x1024x512 f32 allocates 16.21 MB — 216 KB over). Clamp
-        # those paths to 512^3 tiles (~8 MB with double buffers),
-        # VMEM-validated at 2048^2 on the chip.
-        bn = min(bn, 512)
-        bk = min(bk, 512)
+    full_width = f32_product or (not stream_bf16
+                                 and x.dtype == jnp.float32)
+    # Default tiles depend on block width. bf16-streamed: the r3 swept
+    # winner 512x1024x1024 (174.8 TFLOPS = 1.093x dot_general). Paths
+    # whose blocks travel HBM->VMEM at full f32 width (precision=
+    # "float32", or stream_bf16=False on f32 inputs) default to 512^3 —
+    # the streamed tile's f32 blocks measured 16.21 MB against the
+    # 16 MB scoped budget (216 KB over); 512^3 is ~8 MB with double
+    # buffers, VMEM-validated at 2048^2 on the chip. EXPLICIT bn/bk are
+    # honored as given (tools/tune_matmul.py's sweep contract: an
+    # over-budget tile must fail loudly, not silently time a clamped
+    # duplicate under its label).
+    if bn is None:
+        bn = 512 if full_width else 1024
+    if bk is None:
+        bk = 512 if full_width else 1024
     inner = y.shape[-1] if transpose_b else y.shape[0]
     if x.ndim != 2 or y.ndim != 2 or x.shape[1] != inner:
         op = "@T" if transpose_b else "@"

@@ -352,15 +352,16 @@ def cwt_sharded(x, scales, wavelet="ricker", *, mesh, axis="scale",
     scales, n, x_complex = _cwt_args(x, scales, wavelet)
     _check_axis_divides(len(scales), mesh, axis, "scale")
     x = jnp.asarray(x, jnp.complex64 if x_complex else jnp.float32)
-    bank_fft, L, is_complex = _bank_fft(wavelet, scales, n, float(w),
-                                        x_complex)
+    bank_re, bank_im, L, is_complex = _bank_fft(wavelet, scales, n,
+                                                float(w), x_complex)
 
-    def local(x_rep, bank_loc):
-        return _cwt_xla(x_rep, bank_loc, L, n,
+    def local(x_rep, re_loc, im_loc):
+        return _cwt_xla(x_rep, re_loc, im_loc, L, n,
                         "complex" if is_complex else "real")
 
     nb = x.ndim - 1  # batch dims of x: replicated
     out_spec = P(*([None] * nb), axis, None)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(P(), P(axis, None)), out_specs=out_spec)
-    return fn(x, bank_fft)
+                   in_specs=(P(), P(axis, None), P(axis, None)),
+                   out_specs=out_spec)
+    return fn(x, bank_re, bank_im)

@@ -37,10 +37,42 @@ def _msps(st: dict, samples: int, digits: int = 1) -> dict:
     ``value`` is the paired-floor-corrected rate, ``raw_value`` the
     uncorrected wall-clock rate (always <= value; the unimpeachable
     bound when tunnel-floor drift makes the correction suspect). A
-    floored (NaN) corrected time reports null, keeping the raw bound."""
-    return {"value": _rate(st["sec"], samples, digits),
-            "raw_value": _rate(st["raw_sec"], samples, digits),
-            "unit": "MSamples/s", "vs_baseline": None}
+    floored (NaN) corrected time reports null, keeping the raw bound; a
+    failed leg (benchlib failed-leg isolation) also carries its
+    ``error`` so a null is never unexplained in the artifact."""
+    rec = {"value": _rate(st["sec"], samples, digits),
+           "raw_value": _rate(st["raw_sec"], samples, digits),
+           "unit": "MSamples/s", "vs_baseline": None}
+    if st.get("error"):
+        rec["error"] = st["error"]
+    return rec
+
+
+def _attach_leg_errors(rec: dict, sts: dict) -> dict:
+    """Copy failed-leg reasons from a chain_stats result into the
+    emitted record (side legs don't go through _msps). A message the
+    record already carries as its own ``error`` (the best leg itself
+    failed) is not duplicated."""
+    errs = {name: s["error"] for name, s in sts.items()
+            if isinstance(s, dict) and s.get("error")
+            and s["error"] != rec.get("error")}
+    if errs:
+        rec["leg_errors"] = errs
+    return rec
+
+
+def _best_leg(sts: dict, names=None) -> dict:
+    """Best record among legs: finite corrected sec first, then finite
+    raw bound, then (all legs failed) the last error record — NaN-safe
+    (min() over NaN keys silently keeps the first element)."""
+    recs = [sts[k] for k in (names if names is not None else sts)]
+    ok = [s for s in recs if s["sec"] == s["sec"]]
+    if ok:
+        return min(ok, key=lambda s: s["sec"])
+    rawok = [s for s in recs if s["raw_sec"] == s["raw_sec"]]
+    if rawok:
+        return min(rawok, key=lambda s: s["raw_sec"])
+    return recs[-1]
 
 
 def bench_elementwise(scale=1):
@@ -70,12 +102,15 @@ def bench_elementwise(scale=1):
         return None if r is None else round(r / 1e3, 2)
 
     gbps = _rate(st["sec"], 8 * n, 5)  # read + write, 4 B each
-    return {"metric": f"elementwise_add_mul_scale_n{n}",
-            "value": gops(st["sec"]),
-            "raw_value": gops(st["raw_sec"]),
-            "unit": "Gop/s", "vs_baseline": None,
-            "effective_gbps":
-                None if gbps is None else round(gbps / 1e3, 1)}
+    rec = {"metric": f"elementwise_add_mul_scale_n{n}",
+           "value": gops(st["sec"]),
+           "raw_value": gops(st["raw_sec"]),
+           "unit": "Gop/s", "vs_baseline": None,
+           "effective_gbps":
+               None if gbps is None else round(gbps / 1e3, 1)}
+    if st.get("error"):
+        rec["error"] = st["error"]
+    return rec
 
 
 def bench_convolve(scale=1):
@@ -114,14 +149,13 @@ def bench_convolve(scale=1):
                       x, iters=8192, on_floor="nan")
     # headline value = best PRODUCTION path (what ops.convolve's selector
     # can actually deliver); the opt-in hand kernel reports on the side
-    prod = [sts[k] for k in ("os", "direct") if sts[k]["sec"] == sts[k]["sec"]]
-    best = (min(prod, key=lambda s: s["sec"]) if prod
-            else min((sts["os"], sts["direct"]),
-                     key=lambda s: s["raw_sec"]))  # all floored: raw only
+    # production paths only (the opt-in hand kernel reports on the side)
+    best = _best_leg(sts, ("os", "direct"))
     rec = {"metric": f"convolve_n{n}_m{m}", **_msps(best, n),
            "overlap_save_msps": _rate(sts["os"]["sec"], n),
            "direct_shift_msps": _rate(sts["direct"]["sec"], n),
            "direct_pallas_msps": _rate(sts["direct_pallas"]["sec"], n)}
+    _attach_leg_errors(rec, sts)
     return rec
 
 
@@ -154,13 +188,12 @@ def bench_convolve_batched(scale=1):
 
     sts = chain_stats({"os": step_os, "direct": step_direct}, x, iters=512,
                       null_carry=x[:1, :8], on_floor="nan")
-    ok = [s for s in sts.values() if s["sec"] == s["sec"]]
-    best = (min(ok, key=lambda s: s["sec"]) if ok
-            else min(sts.values(), key=lambda s: s["raw_sec"]))
-    return {"metric": f"convolve_batched_b{batch}_n{n}_m{m}",
-            **_msps(best, batch * n),
-            "overlap_save_msps": _rate(sts["os"]["sec"], batch * n),
-            "direct_shift_msps": _rate(sts["direct"]["sec"], batch * n)}
+    best = _best_leg(sts)
+    return _attach_leg_errors(
+        {"metric": f"convolve_batched_b{batch}_n{n}_m{m}",
+         **_msps(best, batch * n),
+         "overlap_save_msps": _rate(sts["os"]["sec"], batch * n),
+         "direct_shift_msps": _rate(sts["direct"]["sec"], batch * n)}, sts)
 
 
 def bench_dwt(scale=1):
@@ -208,7 +241,7 @@ def bench_dwt(scale=1):
     xs, p = sts["xla"]["sec"], sts["pallas"]["sec"]
     if xs == xs and p == p:  # both un-floored: the ratio is meaningful
         rec["pallas_vs_xla"] = round(xs / p, 3)
-    return rec
+    return _attach_leg_errors(rec, sts)
 
 
 def bench_batched_pipeline(scale=1):
@@ -418,8 +451,7 @@ def bench_iir_long(scale=1):
     # worker watchdog caps a single execution at ~60 s (see bench_iir).
     sts = chain_stats({"flat": make(0), "chunked": make(4096)}, x,
                       iters=16, on_floor="nan", null_carry=x[:1, :8])
-    best = min(sts.values(),
-               key=lambda s: s["sec"] if s["sec"] == s["sec"] else 1e30)
+    best = _best_leg(sts)
     rec = {"metric": f"sosfilt_long_b{batch}_n{n}",
            **_msps(best, batch * n),
            "flat_msps": _rate(sts["flat"]["sec"], batch * n),
@@ -427,7 +459,7 @@ def bench_iir_long(scale=1):
     f, c = sts["flat"]["sec"], sts["chunked"]["sec"]
     if f == f and c == c:
         rec["chunked_vs_flat"] = round(f / c, 3)
-    return rec
+    return _attach_leg_errors(rec, sts)
 
 
 CONFIGS = (bench_elementwise, bench_convolve, bench_convolve_batched,

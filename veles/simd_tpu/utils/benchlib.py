@@ -52,10 +52,14 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     can show the spread across chip-state drift, not just the best point.
     ``raw_sec`` is the best total wall-clock divided by ``iters`` with no
     floor subtraction — the unimpeachable lower bound on rate claims.
-    Raises on non-finite checksums. A config whose total is
-    indistinguishable from the null-chain floor has no meaningful
-    corrected rate: ``on_floor="raise"`` (default) raises,
-    ``on_floor="nan"`` reports NaN for that config and keeps the rest.
+    A config whose total is indistinguishable from the null-chain floor
+    has no meaningful corrected rate: ``on_floor="raise"`` (default)
+    raises, ``on_floor="nan"`` reports NaN for that config and keeps
+    the rest. A named chain that fails to compile or run at warm-up, or
+    whose warm-up checksum is non-finite (a backend capability outage
+    or a numerics bug), is reported as ``{"sec": nan, ..., "error":
+    msg}`` while the surviving chains are timed normally; only a
+    failure of the implicit null chain aborts the whole call.
 
     The null chain runs over ``carry`` by default, which also cancels one
     HBM stream pass over it per step — right for measuring compute on top
@@ -79,10 +83,37 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     if null_carry is not None:
         carries["__null__"] = null_carry
 
-    for name, chain in chains.items():
-        value = float(chain(carries[name]))  # compile + warm
+    failed = {}
+    for name, chain in list(chains.items()):
+        try:
+            value = float(chain(carries[name]))  # compile + warm
+        except Exception as e:
+            # one leg failing to compile/run (e.g. the FFT leg while the
+            # tunnel's FFT capability is out — observed r3) must not
+            # zero the whole config: record it and time the rest
+            if name == "__null__":
+                raise  # the floor chain is load-bearing for every leg
+            failed[name] = f"{type(e).__name__}: {e}"[:500]
+            del chains[name]
+            continue
         if not math.isfinite(value):
-            raise RuntimeError(f"non-finite checksum from {name}: {value}")
+            if name == "__null__":
+                raise RuntimeError(
+                    f"non-finite checksum from the null chain: {value}")
+            # same isolation as a raise: a leg computing garbage (r3:
+            # the tunnel compiled FFT custom-calls that silently
+            # produced NaN while direct rfft readback said
+            # UNIMPLEMENTED) must not kill its siblings, and the reason
+            # must reach the artifact rather than become a bare null
+            failed[name] = f"non-finite checksum: {value}"
+            del chains[name]
+
+    if failed and on_floor == "raise":
+        # strict mode keeps the loud contract at the stats layer too
+        # (a floored config raises below; a failed one must not be
+        # quieter than that)
+        name, msg = next(iter(failed.items()))
+        raise RuntimeError(f"leg '{name}' failed: {msg}")
 
     # ``attempts`` spaced groups of ``reps`` reuse the compiled chains —
     # cheap resilience against multi-second chip/tunnel state drift
@@ -144,6 +175,10 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
                          "raw_sec": best_total / iters,
                          "floor_sec": floors[idx] / iters,
                          "attempt_sec": attempt_sec}
+    for name, msg in failed.items():
+        out[name] = {"sec": float("nan"), "raw_sec": float("nan"),
+                     "floor_sec": float("nan"), "attempt_sec": [],
+                     "error": msg}
     return out
 
 
