@@ -347,8 +347,8 @@ class TestLombscargleSharded:
                 weights=np.ones(49))
 
 
-@pytest.mark.native_complex
 class TestCwtSharded:
+    @pytest.mark.native_complex  # morlet2 output readback is complex
     def test_matches_single_device(self, rng):
         m = parallel.make_mesh({"scale": 8})
         x = rng.normal(size=512).astype(np.float32)
@@ -368,6 +368,7 @@ class TestCwtSharded:
         with pytest.raises(ValueError, match="multiple"):
             parallel.cwt_sharded(x, scales[:-1], mesh=m)
 
+    @pytest.mark.native_complex
     def test_complex_input_and_tiny_scale(self, rng):
         """Analytic input keeps its imaginary part on the sharded path
         too; degenerate scales raise cwt's clear error (review r3)."""

@@ -146,6 +146,7 @@ def test_dlsim_random_systems(seed):
                                err_msg=f"seed={seed} S={S} n={n}")
 
 
+@pytest.mark.native_complex
 @pytest.mark.parametrize("seed", range(6))
 def test_welch_family_random(seed):
     from veles.simd_tpu.reference import spectral as refs

@@ -99,6 +99,7 @@ class TestWelchDetrend:
         assert p_raw[0] > 1e3 * p_dt[0]
 
     @pytest.mark.parametrize("kind", ["constant", "linear"])
+    @pytest.mark.native_complex  # reads the complex csd back
     def test_matches_oracle(self, rng, kind):
         from veles.simd_tpu.reference import spectral as refs
 

@@ -220,6 +220,7 @@ def test_istft_impl_reference_differential(rng):
     assert w.shape == (1200,) and np.all(w[1100:] == 0)
 
 
+@pytest.mark.native_complex  # the analytic signal is complex64
 class TestHilbert:
     """Analytic signal / envelope vs scipy oracle."""
 
@@ -275,6 +276,7 @@ class TestDetrend:
 
 
 class TestCsdCoherence:
+    @pytest.mark.native_complex  # reads the complex csd back
     def test_csd_of_self_is_welch(self, rng):
         x = rng.normal(size=4096).astype(np.float32)
         pxx = np.asarray(ops.welch(x, nfft=256))
@@ -282,6 +284,7 @@ class TestCsdCoherence:
         np.testing.assert_allclose(pxy.imag, 0.0, atol=1e-8)
         np.testing.assert_allclose(pxy.real, pxx, rtol=1e-4, atol=1e-8)
 
+    @pytest.mark.native_complex  # reads the complex csd back
     def test_matches_oracle(self, rng):
         from veles.simd_tpu.reference import spectral as refs
         x = rng.normal(size=(2, 4096)).astype(np.float32)

@@ -9,6 +9,7 @@ nothing). Oracle: scipy.signal itself via ``impl="reference"``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,8 +36,32 @@ def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
         from scipy.signal import chirp as _chirp
         return _chirp(np.asarray(t, np.float64), f0, t1, f1,
                       method=method, phi=phi)
-    t = jnp.asarray(t, jnp.float32)
     degenerate = f0 == f1  # host-side: f0/f1 are call-time scalars
+    if not isinstance(t, jax.Array):
+        # host time grid (the scipy calling convention): evaluate the
+        # phase in float64 on host and reduce mod 2*pi BEFORE the f32
+        # cast. On-chip, XLA's log/exp are hardware approximations
+        # (~5e-5 relative — BASELINE.md accuracy notes) and the
+        # log/hyperbolic phases multiply that error up to whole radians
+        # (the r3 TPU suite measured the hyperbolic sweep off by 7e-3);
+        # large angles also outrun f32 resolution. Traced/device inputs
+        # take the on-device branch below and keep its accuracy note.
+        th = np.asarray(t, np.float64)
+        if method == "linear":
+            ph = f0 * th + (f1 - f0) / (2 * t1) * th * th
+        elif method == "quadratic":
+            ph = f0 * th + (f1 - f0) / (3 * t1 * t1) * th ** 3
+        elif degenerate:
+            ph = f0 * th
+        elif method == "logarithmic":
+            k = np.log(f1 / f0)
+            ph = f0 * t1 / k * (np.exp(th / t1 * k) - 1.0)
+        else:  # hyperbolic
+            sing = -f1 * t1 / (f0 - f1)
+            ph = -f0 * sing * np.log(np.abs(1.0 - th / sing))
+        ang = np.mod(2 * np.pi * ph + np.deg2rad(phi), 2 * np.pi)
+        return jnp.cos(jnp.asarray(ang, jnp.float32))
+    t = jnp.asarray(t, jnp.float32)
     f0 = jnp.float32(f0)
     f1 = jnp.float32(f1)
     t1 = jnp.float32(t1)

@@ -105,6 +105,62 @@ def _round_halo(halo):
     return -(-halo // _LANES) * _LANES if halo else 0
 
 
+def _stack_cap(bl, bb, order):
+    """Cap the block length so the tap loop's live temporaries fit the
+    16 MB VMEM stack: each of ~``order`` unrolled taps holds a (bb, bl)
+    f32 window slice, and Mosaic keeps them all live (measured on-chip:
+    SWT db8 at (16, 131072), bb=8, bl=32768 allocates 16.64 MB — 656 KB
+    over; same failure class as the FIR kernel's runtime-tap cap). 2M
+    f32 elements ~= 8 MB of stack leaves room for accumulators and
+    double buffers."""
+    stack_elems = 2 << 20
+    return min(bl, max(_LANES, (stack_elems // (bb * max(order, 1)))
+                       // _LANES * _LANES))
+
+
+def _row_group(pb, bb, out_len, n_out=2):
+    """Rows per pallas_call such that one call's OUTPUT arrays stay
+    under ~8 MiB. The axon AOT pipeline allocates a pallas custom-call's
+    whole output in scoped VMEM for multi-row (8-sublane-tiled) shapes:
+    the SWT at (16, 131072) failed with a 16.64 MiB scoped allocation —
+    exactly its two full 8 MiB outputs plus the working blocks — at ANY
+    kernel block size. Callers loop the batch in groups of this many
+    rows (a multiple of bb; the loop unrolls at trace time)."""
+    budget = (8 << 20) // (4 * n_out)  # f32 elements per output
+    rows = budget // max(out_len, 1)   # rows whose outputs fit
+    return max(bb, min(pb, rows // bb * bb))
+
+
+def _grouped_bank_call(inputs, kernel, bb, bl, halo_pad, out_len):
+    '''Run a dual-band kernel over batch-row groups sized by
+    `_row_group` and concatenate: shared by the DWT and SWT banks so
+    the VMEM-output budget lives in one place. ``inputs`` is a tuple of
+    (pb, in_len) arrays sharing the same halo spec.'''
+    pb = inputs[0].shape[0]
+    g = _row_group(pb, bb, out_len)
+    his, los = [], []
+    for r0 in range(0, pb, g):
+        rows = tuple(a[r0:r0 + g] for a in inputs)
+        gr = rows[0].shape[0]
+        spec = _halo_spec(bb, bl, halo_pad, gr // bb)
+        hi_g, lo_g = pl.pallas_call(
+            kernel,
+            grid=(gr // bb, out_len // bl),
+            in_specs=[spec] * len(rows),
+            out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
+            out_shape=[jax.ShapeDtypeStruct((gr, out_len),
+                                            jnp.float32)] * 2,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=use_interpret(),
+        )(*rows)
+        his.append(hi_g)
+        los.append(lo_g)
+    hi = his[0] if len(his) == 1 else jnp.concatenate(his, axis=0)
+    lo = los[0] if len(los) == 1 else jnp.concatenate(los, axis=0)
+    return hi, lo
+
+
 def _dwt_kernel(even_ref, odd_ref, hi_ref, lo_ref, *, taps_hi, taps_lo,
                 out_len):
     even = even_ref[...]
@@ -143,6 +199,7 @@ def _dwt_call(x_ext, taps_hi, taps_lo):
     x2 = x_ext.reshape(batch, x_ext.shape[-1])
 
     bb, bl = _tile(batch, max(half, _LANES))
+    bl = _stack_cap(bl, bb, order)
     halo_pad = _round_halo(halo)
     out_len = -(-half // bl) * bl  # half rounded up to a whole block grid
     in_len = out_len + halo_pad
@@ -152,18 +209,8 @@ def _dwt_call(x_ext, taps_hi, taps_lo):
     odd = _pad_batch(_pad_to(_lane_phase(x2, 1), in_len), bb)
     kernel = functools.partial(_dwt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
                                out_len=bl)
-    pb = even.shape[0]
-    in_spec = _halo_spec(bb, bl, halo_pad, pb // bb)
-    hi, lo = pl.pallas_call(
-        kernel,
-        grid=(pb // bb, out_len // bl),
-        in_specs=[in_spec, in_spec],
-        out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((pb, out_len), jnp.float32)] * 2,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=use_interpret(),
-    )(even, odd)
+    hi, lo = _grouped_bank_call((even, odd), kernel, bb, bl, halo_pad,
+                                out_len)
     return hi[:batch, :half].reshape(lead + (half,)), \
         lo[:batch, :half].reshape(lead + (half,))
 
@@ -218,22 +265,15 @@ def _swt_call(x_ext, taps_hi, taps_lo, stride, out_length):
     x2 = x_ext.reshape(batch, x_ext.shape[-1])
 
     bb, bl = _tile(batch, max(out_length, _LANES))
+    bl = _stack_cap(bl, bb, len(taps_hi))
     halo_pad = _round_halo(halo)
     out_len = -(-out_length // bl) * bl
     x2 = _pad_batch(_pad_to(x2, out_len + halo_pad), bb)
     pb = x2.shape[0]
     kernel = functools.partial(_swt_kernel, taps_hi=taps_hi, taps_lo=taps_lo,
                                stride=stride, out_len=bl)
-    hi, lo = pl.pallas_call(
-        kernel,
-        grid=(pb // bb, out_len // bl),
-        in_specs=[_halo_spec(bb, bl, halo_pad, pb // bb)],
-        out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((pb, out_len), jnp.float32)] * 2,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=use_interpret(),
-    )(x2)
+    hi, lo = _grouped_bank_call((x2,), kernel, bb, bl, halo_pad,
+                                out_len)
     return hi[:batch, :out_length].reshape(lead + (out_length,)), \
         lo[:batch, :out_length].reshape(lead + (out_length,))
 

@@ -57,9 +57,11 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     raises, ``on_floor="nan"`` reports NaN for that config and keeps
     the rest. A named chain that fails to compile or run at warm-up, or
     whose warm-up checksum is non-finite (a backend capability outage
-    or a numerics bug), is reported as ``{"sec": nan, ..., "error":
-    msg}`` while the surviving chains are timed normally; only a
-    failure of the implicit null chain aborts the whole call.
+    or a numerics bug), is reported under ``on_floor="nan"`` as
+    ``{"sec": nan, ..., "error": msg}`` while the surviving chains are
+    timed normally; under the default ``on_floor="raise"`` a failed leg
+    raises (with the original exception chained), and a failure of the
+    implicit null chain always aborts the whole call.
 
     The null chain runs over ``carry`` by default, which also cancels one
     HBM stream pass over it per step — right for measuring compute on top
@@ -84,6 +86,7 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
         carries["__null__"] = null_carry
 
     failed = {}
+    causes = {}
     for name, chain in list(chains.items()):
         try:
             value = float(chain(carries[name]))  # compile + warm
@@ -94,6 +97,7 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
             if name == "__null__":
                 raise  # the floor chain is load-bearing for every leg
             failed[name] = f"{type(e).__name__}: {e}"[:500]
+            causes[name] = e
             del chains[name]
             continue
         if not math.isfinite(value):
@@ -111,9 +115,11 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     if failed and on_floor == "raise":
         # strict mode keeps the loud contract at the stats layer too
         # (a floored config raises below; a failed one must not be
-        # quieter than that)
+        # quieter than that); chain the original exception so its type
+        # and traceback stay debuggable
         name, msg = next(iter(failed.items()))
-        raise RuntimeError(f"leg '{name}' failed: {msg}")
+        raise RuntimeError(
+            f"leg '{name}' failed: {msg}") from causes.get(name)
 
     # ``attempts`` spaced groups of ``reps`` reuse the compiled chains —
     # cheap resilience against multi-second chip/tunnel state drift
