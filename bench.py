@@ -247,7 +247,15 @@ def _read_progress(paths) -> dict:
             if "__headline__" in rec:
                 headline = rec["__headline__"]
             elif "metric" in rec:
-                configs[rec.pop("metric")] = rec
+                # the worker annotates ref_avx only at the end of a FULL
+                # run; streamed configs arrive bare, so annotate here —
+                # a merged partial record must carry the same honest
+                # speedup column as a complete one (observed r3: a
+                # worker death at the 10th config produced a record
+                # with every vs_ref_avx null)
+                metric = rec.pop("metric")
+                _annotate_ref_avx(rec, metric)
+                configs[metric] = rec
     out = dict(headline) if headline else {}
     if configs:
         out["configs"] = configs

@@ -220,11 +220,11 @@ def test_istft_impl_reference_differential(rng):
     assert w.shape == (1200,) and np.all(w[1100:] == 0)
 
 
-@pytest.mark.native_complex  # the analytic signal is complex64
 class TestHilbert:
     """Analytic signal / envelope vs scipy oracle."""
 
     @pytest.mark.parametrize("n", [64, 129, 1024])
+    @pytest.mark.native_complex  # reads the complex analytic signal
     def test_matches_scipy(self, rng, n):
         from veles.simd_tpu.reference import spectral as refs
         x = rng.normal(size=n).astype(np.float32)

@@ -136,6 +136,34 @@ def _sosfilt_xla(x, sos, s0, n_sections, chunk=0):
         batch, n_sections, 2)
     use_chunked = chunk and n > chunk
 
+    if use_chunked or n > 32768:
+        # UNROLLED cascade for long signals: wrapping the section math
+        # in a section-axis lax.scan makes the scans nest three deep
+        # once a caller's scan (or a bench chain) encloses the op, and
+        # the XLA:TPU compile falls off a cliff — a 16-step chain of
+        # (16, 262144) sosfilt never finished compiling in 10 minutes,
+        # for BOTH the blocked form (chain/cascade/block scans) and the
+        # flat form (chain/cascade/262k-level associative scan), while
+        # the unrolled equivalents compile in seconds and measured
+        # 358 / 134 MS/s on-chip. Long signals are the rare case — six
+        # inlined section copies is fine.
+        finals = []
+        yT = xT
+        for k in range(n_sections):
+            coeffs = (sos[k, 0], sos[k, 1], sos[k, 2], sos[k, 4],
+                      sos[k, 5])
+            if use_chunked:
+                yT, z1f, z2f = _section_scan_chunked_T(
+                    yT, coeffs, s0f[:, k, 0], s0f[:, k, 1], chunk)
+            else:
+                yT, z1f, z2f = _section_scan_T(
+                    yT, coeffs, s0f[:, k, 0], s0f[:, k, 1])
+            finals.append(jnp.stack([z1f, z2f], axis=-1))
+        y = yT.T.reshape(lead + (n,))
+        s_fin = jnp.stack(finals, axis=-2).reshape(
+            lead + (n_sections, 2))
+        return y, s_fin
+
     # cascade via lax.scan over the section axis: the per-section scan
     # tree is compiled ONCE, not inlined per section (a Python loop over
     # 6 sections measured 15 s of CPU compile for the flat tree alone;
@@ -143,11 +171,7 @@ def _sosfilt_xla(x, sos, s0, n_sections, chunk=0):
     def cascade_body(yT, per):
         cf, z0k = per  # (6,) sos row, (batch, 2) incoming state
         coeffs = (cf[0], cf[1], cf[2], cf[4], cf[5])
-        if use_chunked:
-            yT, z1f, z2f = _section_scan_chunked_T(yT, coeffs, z0k[:, 0],
-                                                   z0k[:, 1], chunk)
-        else:
-            yT, z1f, z2f = _section_scan_T(yT, coeffs, z0k[:, 0], z0k[:, 1])
+        yT, z1f, z2f = _section_scan_T(yT, coeffs, z0k[:, 0], z0k[:, 1])
         return yT, jnp.stack([z1f, z2f], axis=-1)
 
     yT, finals = jax.lax.scan(cascade_body, xT,

@@ -18,6 +18,30 @@ from veles.simd_tpu.config import resolve_impl
 _CHIRP_METHODS = ("linear", "quadratic", "logarithmic", "hyperbolic")
 
 
+
+def _chirp_phase(xp, t, f0, t1, f1, method, degenerate):
+    """Phase integral of the swept frequency, in units of cycles. One
+    source of truth for both the host-f64 path (xp=numpy) and the
+    traced/device path (xp=jax.numpy) — the formulas must never drift
+    apart (scipy.signal.chirp's closed forms)."""
+    if method == "linear":
+        return f0 * t + (f1 - f0) / (2 * t1) * t * t
+    if method == "quadratic":
+        return f0 * t + (f1 - f0) / (3 * t1 * t1) * t * t * t
+    if degenerate:
+        # log/hyperbolic sweep to the same frequency IS a pure tone;
+        # the closed forms below divide by log(f1/f0)=0 / (f0-f1)=0
+        # (scipy special-cases this identically)
+        return f0 * t
+    if method == "logarithmic":
+        # phase integral of f0 * (f1/f0)^(t/t1)
+        k = xp.log(f1 / f0)
+        return f0 * t1 / k * (xp.exp(t / t1 * k) - 1.0)
+    # hyperbolic: f(t) = f0*f1*t1 / ((f0 - f1) t + f1 t1)
+    sing = -f1 * t1 / (f0 - f1)
+    return -f0 * sing * xp.log(xp.abs(1.0 - t / sing))
+
+
 def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
     """Swept-frequency cosine (scipy.signal.chirp): instantaneous
     frequency runs f0 at t=0 to f1 at t=t1 along ``method`` (linear,
@@ -47,40 +71,12 @@ def chirp(t, f0, t1, f1, method="linear", phi=0, *, impl=None):
         # large angles also outrun f32 resolution. Traced/device inputs
         # take the on-device branch below and keep its accuracy note.
         th = np.asarray(t, np.float64)
-        if method == "linear":
-            ph = f0 * th + (f1 - f0) / (2 * t1) * th * th
-        elif method == "quadratic":
-            ph = f0 * th + (f1 - f0) / (3 * t1 * t1) * th ** 3
-        elif degenerate:
-            ph = f0 * th
-        elif method == "logarithmic":
-            k = np.log(f1 / f0)
-            ph = f0 * t1 / k * (np.exp(th / t1 * k) - 1.0)
-        else:  # hyperbolic
-            sing = -f1 * t1 / (f0 - f1)
-            ph = -f0 * sing * np.log(np.abs(1.0 - th / sing))
+        ph = _chirp_phase(np, th, f0, t1, f1, method, degenerate)
         ang = np.mod(2 * np.pi * ph + np.deg2rad(phi), 2 * np.pi)
         return jnp.cos(jnp.asarray(ang, jnp.float32))
     t = jnp.asarray(t, jnp.float32)
-    f0 = jnp.float32(f0)
-    f1 = jnp.float32(f1)
-    t1 = jnp.float32(t1)
-    if method == "linear":
-        phase = f0 * t + (f1 - f0) / (2 * t1) * t * t
-    elif method == "quadratic":
-        phase = f0 * t + (f1 - f0) / (3 * t1 * t1) * t * t * t
-    elif degenerate:
-        # log/hyperbolic sweep to the same frequency IS a pure tone;
-        # the closed forms below divide by log(f1/f0)=0 / (f0-f1)=0
-        # (scipy special-cases this identically)
-        phase = f0 * t
-    elif method == "logarithmic":
-        # phase integral of f0 * (f1/f0)^(t/t1)
-        k = jnp.log(f1 / f0)
-        phase = f0 * t1 / k * (jnp.exp(t / t1 * k) - 1.0)
-    else:  # hyperbolic: f(t) = f0*f1*t1 / ((f0 - f1) t + f1 t1)
-        sing = -f1 * t1 / (f0 - f1)
-        phase = -f0 * sing * jnp.log(jnp.abs(1.0 - t / sing))
+    phase = _chirp_phase(jnp, t, jnp.float32(f0), jnp.float32(t1),
+                         jnp.float32(f1), method, degenerate)
     return jnp.cos(2 * jnp.pi * phase
                    + jnp.float32(np.pi / 180) * jnp.float32(phi))
 
