@@ -25,9 +25,14 @@ Measurement method: utils/benchlib.py — the op is iterated inside one jit'd
 lax.scan with a data dependency between steps, and a null chain's total is
 subtracted (the axon tunnel defers execution past block_until_ready and
 adds a ~70 ms round trip, so per-dispatch wall-clocking measures nothing).
-The headline corrected GFLOPS carries a sanity clamp: a value above the
-chip's bf16 peak is reported clamped to peak with ``clamped: true`` (the
-paired floor can over-correct when the tunnel drifts mid-rep).
+Every GFLOPS figure carries a physics clamp: a value above the chip's
+bf16 peak is reported clamped to peak, with the touched field names in
+``clamped_fields`` (the paired floor can over-correct when the tunnel
+drifts mid-rep; round 3's artifact shipped 146%-of-peak side legs).
+
+The final stdout line is budget-bound (``LINE_BUDGET`` < the driver's
+2,000-byte tail capture) via ``emit_record``; the complete unpruned
+record lands in ``bench_full_last.json`` beside this file.
 """
 
 import argparse
@@ -41,6 +46,170 @@ import time
 V5E_BF16_PEAK_GFLOPS = 197_000.0
 TARGET_GFLOPS = 0.5 * V5E_BF16_PEAK_GFLOPS
 HEADLINE_METRIC = "matrix_multiply_f32_n4096"
+
+# The driver captures only the LAST 2,000 bytes of stdout; round 3's
+# record was ~2.1 KB and lost its head ("metric", "value") to the tail
+# window — rc 0, parsed null. Every final print now goes through
+# emit_record(), which serializes compactly and prunes lowest-value
+# fields until the line fits this budget (headroom under 2,000 for the
+# driver's own wrapping). tests/test_bench_line.py pins the contract:
+# the full record must json.loads from the line's last 2,000 bytes.
+LINE_BUDGET = 1780
+_CFG_DEFAULT_UNIT = "MSamples/s"
+
+
+def _clamp_peak_fields(result: dict) -> dict:
+    """Physics-bound every GFLOPS figure at the chip's bf16 peak.
+
+    The RTT-floor correction can overshoot when the tunnel drifts
+    mid-rep; round 3's driver artifact carried pallas_gflops=287,984 —
+    146% of the v5e's 197 TFLOPS peak. The headline ``value`` was
+    already clamped; this clamps the rest (side legs, attempt spreads,
+    and — defensively — the raw wall-clock bounds, which cannot
+    legitimately exceed peak at all) and records which fields were
+    touched in ``clamped_fields`` so the artifact never contains a
+    physically impossible number without saying so."""
+    def cl(v):
+        if isinstance(v, (int, float)) and v > V5E_BF16_PEAK_GFLOPS:
+            return V5E_BF16_PEAK_GFLOPS, True
+        return v, False
+
+    flagged = []
+    for key in ("value", "pallas_gflops", "pallas_raw_gflops", "raw_value"):
+        v, c = cl(result.get(key))
+        if c:
+            result[key] = v
+            flagged.append(key)
+    for key in ("attempts", "pallas_attempts"):
+        vals = result.get(key)
+        if isinstance(vals, list):
+            clamped_list, changed = [], False
+            for v in vals:
+                v2, c = cl(v)
+                clamped_list.append(v2)
+                changed |= c
+            if changed:
+                result[key] = clamped_list
+                flagged.append(key)
+    if flagged:
+        result["clamped_fields"] = flagged
+    return result
+
+
+def _prune_steps(rec: dict):
+    """Ordered field-drop ladder for an over-budget line, least
+    load-bearing first. The full unpruned record is always preserved in
+    ``bench_full_last.json`` beside this file, so pruning only trims the
+    driver's one-line view, never the evidence."""
+    def all_recs():
+        cfgs = rec.get("configs") or {}
+        return [rec] + [c for c in cfgs.values() if isinstance(c, dict)]
+
+    def trunc_errors(limit):
+        for d in all_recs():
+            if isinstance(d.get("error"), str):
+                d["error"] = d["error"][-limit:]
+            le = d.get("leg_errors")
+            if isinstance(le, dict):
+                d["leg_errors"] = {k: str(v)[-(limit // 2):]
+                                   for k, v in le.items()}
+
+    side_keys = ("effective_gbps", "overlap_save_msps",
+                 "direct_pallas_msps", "direct_shift_msps", "pallas_msps",
+                 "flat_msps", "chunked_msps", "pallas_vs_xla",
+                 "chunked_vs_flat", "pipelined_msps")
+    # the irreducible per-config facts; everything else may be shed
+    essential = ("value", "raw_value", "unit", "vs_ref_avx", "error")
+
+    def drop_cfg_keys(keys):
+        for cfg in (rec.get("configs") or {}).values():
+            if isinstance(cfg, dict):
+                for k in keys:
+                    cfg.pop(k, None)
+
+    def whitelist_cfgs():  # catch-all: bounds unknown future fields too
+        for cfg in (rec.get("configs") or {}).values():
+            if isinstance(cfg, dict):
+                for k in [k for k in cfg if k not in essential]:
+                    del cfg[k]
+
+    return [lambda: trunc_errors(300),
+            # per-config raw speedups first: derivable by the reader
+            # from raw_value + REF_BASELINE.json, unlike what follows
+            lambda: drop_cfg_keys(("vs_ref_avx_raw",)),
+            lambda: drop_cfg_keys(side_keys),
+            lambda: rec.pop("pallas_attempts", None),
+            lambda: rec.pop("attempts", None),
+            lambda: trunc_errors(80),
+            whitelist_cfgs,
+            lambda: drop_cfg_keys(("raw_value",))]
+
+
+def emit_record(result: dict, budget: int | None = LINE_BUDGET) -> str:
+    """Serialize the bench record as ONE compact JSON line under budget.
+
+    Compaction that loses nothing: tight separators, per-config
+    ``vs_baseline: null`` dropped (only the headline has a real one),
+    and the ubiquitous per-config ``"unit": "MSamples/s"`` hoisted to a
+    single top-level ``cfg_unit`` default (consumers:
+    tools/speedup_table.py, tools/evidence_table.py). If the line still
+    exceeds ``budget``, _prune_steps drops fields in priority order and
+    the count lands in ``pruned``. ``budget=None`` skips pruning (the
+    worker->supervisor hop has no tail window)."""
+    rec = json.loads(json.dumps(result))  # deep copy, JSON-typed
+    hoisted = False
+    for cfg in (rec.get("configs") or {}).values():
+        if not isinstance(cfg, dict):
+            continue
+        if cfg.get("vs_baseline") is None:
+            cfg.pop("vs_baseline", None)
+        if cfg.get("unit") == _CFG_DEFAULT_UNIT:
+            del cfg["unit"]
+            hoisted = True
+    if hoisted:
+        rec["cfg_unit"] = _CFG_DEFAULT_UNIT
+    line = json.dumps(rec, separators=(",", ":"))
+    if budget is None or len(line) <= budget:
+        return line
+    pruned = 0
+    for step in _prune_steps(rec):
+        step()
+        pruned += 1
+        line = json.dumps(rec, separators=(",", ":"))
+        if len(line) <= budget - 14:  # room for the pruned marker
+            break
+    rec["pruned"] = pruned
+    # Terminal guarantee: an all-errored partial record (12 configs of
+    # nulls + error strings) can exhaust the ladder still over budget.
+    # Whatever remains, the line MUST fit the driver tail — shed whole
+    # trailing configs last (their names at least survive in
+    # cfgs_dropped's count, and the full record file keeps everything).
+    cfgs = rec.get("configs")
+    while (len(json.dumps(rec, separators=(",", ":"))) > budget - 20
+           and cfgs):
+        cfgs.pop(next(reversed(cfgs)))
+        rec["cfgs_dropped"] = rec.get("cfgs_dropped", 0) + 1
+    return json.dumps(rec, separators=(",", ":"))
+
+
+def _write_full_record(result: dict) -> None:
+    """Persist the complete unpruned record beside this file. The stdout
+    line is budget-bound; this file is the full-detail evidence the
+    in-repo tables (tools/evidence_table.py, tools/speedup_table.py)
+    render from. Format on both the success and failure paths: the
+    compact-but-unpruned shape (units hoisted under ``cfg_unit``), plus
+    a wall-clock stamp so a stale file is self-dating. Real supervisor
+    runs only — the fake-worker unit tests must never clobber evidence
+    (supervise() gates on ``worker_cmd is None``)."""
+    try:
+        rec = json.loads(emit_record(result, budget=None))
+        rec["recorded_unix"] = int(time.time())
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_full_last.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass  # read-only checkout: the stdout line still lands
 
 
 def bench_matmul_4096():
@@ -76,26 +245,21 @@ def bench_matmul_4096():
             return None
         return round(2 * n ** 3 / sec / 1e9, 1)
 
-    xla_g = gflops(sts["xla"]["sec"])
-    raw_g = gflops(sts["xla"]["raw_sec"])
-    clamped = xla_g is not None and xla_g > V5E_BF16_PEAK_GFLOPS
-    value = min(xla_g, V5E_BF16_PEAK_GFLOPS) if clamped else xla_g
-    pallas_g = gflops(sts["pallas"]["sec"])
+    def gflops_i(sec):  # attempt spreads: whole GFLOPS (line budget)
+        g = gflops(sec)
+        return None if g is None else round(g)
+
     # per-attempt corrected values: the artifact shows the spread across
     # chip-state drift (observed ~2x), not just the clamped best point
-    attempts_g = [gflops(s) for s in sts["xla"].get("attempt_sec", [])]
     result = {
         "metric": f"matrix_multiply_f32_n{n}",
-        "value": value,
+        "value": gflops(sts["xla"]["sec"]),
         "unit": "GFLOPS",
-        "vs_baseline": (round(value / TARGET_GFLOPS, 4)
-                        if value is not None else None),
-        "raw_value": raw_g,
-        "clamped": clamped,
-        "attempts": attempts_g,
-        "pallas_gflops": pallas_g,
+        "raw_value": gflops(sts["xla"]["raw_sec"]),
+        "attempts": [gflops_i(s) for s in sts["xla"].get("attempt_sec", [])],
+        "pallas_gflops": gflops(sts["pallas"]["sec"]),
         "pallas_raw_gflops": gflops(sts["pallas"]["raw_sec"]),
-        "pallas_attempts": [gflops(s)
+        "pallas_attempts": [gflops_i(s)
                             for s in sts["pallas"].get("attempt_sec", [])],
     }
     # a leg that failed to compile/run carries its reason into the
@@ -103,8 +267,13 @@ def bench_matmul_4096():
     # measurement (benchlib failed-leg isolation, r3)
     from veles.simd_tpu.utils.bench_extra import _attach_leg_errors
     _attach_leg_errors(result, sts)
-    if xla_g and pallas_g:
-        result["pallas_vs_xla"] = round(pallas_g / xla_g, 3)
+    _clamp_peak_fields(result)  # value included: flagged via clamped_fields
+    value = result["value"]
+    result["vs_baseline"] = (round(value / TARGET_GFLOPS, 3)
+                             if value is not None else None)
+    if value and result.get("pallas_gflops"):
+        # ratio of the clamped figures: both sides physics-bound
+        result["pallas_vs_xla"] = round(result["pallas_gflops"] / value, 3)
     return result
 
 
@@ -150,7 +319,9 @@ def worker_main(headline_only: bool, progress_path: str | None) -> int:
             progress=_Tee(sys.stderr, progress))
         for metric, cfg in result["configs"].items():
             _annotate_ref_avx(cfg, metric)
-    print(json.dumps(result))
+    # compact but unpruned: the supervisor reparses this hop in full and
+    # owns the final budget-bound print
+    print(emit_record(result, budget=None))
     return 0
 
 
@@ -170,14 +341,17 @@ def _load_ref_baseline():
 
 
 def _annotate_ref_avx(rec: dict, metric: str | None = None) -> None:
-    """Attach the measured reference-AVX baseline ratio in place.
+    """Attach the measured reference-AVX baseline ratios in place.
 
     REF_BASELINE.json (tools/ref_baseline.sh: the reference library
     built -O3 -march=native, timed at these exact shapes) shares metric
     names with the bench configs by construction; when a row matches,
-    the record carries ``ref_avx`` (the baseline value) and
-    ``vs_ref_avx`` (TPU / AVX — the honest speedup column) directly,
-    so the driver artifact is self-contained evidence."""
+    the record carries ``vs_ref_avx`` (clamped TPU value / AVX — the
+    honest speedup column) and ``vs_ref_avx_raw`` (uncorrected
+    wall-clock bound / AVX — the floor speedup no tunnel-drift
+    correction can inflate). The baseline value itself is not echoed
+    per-config (line budget); it lives in REF_BASELINE.json, joined by
+    metric name."""
     ref = _load_ref_baseline()
     if ref is None:
         return
@@ -185,8 +359,17 @@ def _annotate_ref_avx(rec: dict, metric: str | None = None) -> None:
     value = rec.get("value")
     if not cfg or not isinstance(value, (int, float)) or not cfg.get("value"):
         return
-    rec["ref_avx"] = cfg["value"]
     rec["vs_ref_avx"] = round(value / cfg["value"], 1)
+    raw = rec.get("raw_value")
+    if isinstance(raw, (int, float)):
+        rec["vs_ref_avx_raw"] = round(raw / cfg["value"], 1)
+    # VERDICT r3 item 7: where the baseline file carries an _fft_proxy
+    # row (the reference's unmeasurable-without-FFTF fast path, proxied
+    # by scipy oaconvolve on the same host), report the ceiling-relative
+    # speedup too, so vs_ref_avx is explicitly the vs-FLOOR column
+    proxy = ref.get((metric or rec.get("metric", "")) + "_fft_proxy")
+    if proxy and proxy.get("value"):
+        rec["vs_ref_fft"] = round(value / proxy["value"], 1)
 
 
 def _parse_worker_json(stdout: str):
@@ -302,7 +485,9 @@ def supervise(headline_only_run: bool = False, *, plans=None,
         if partial:
             rec["note"] = ("partial record: merged from progress stream "
                            "of failed attempt(s)")
-        print(json.dumps(rec))
+        if worker_cmd is None:  # real run, not a fake-worker unit test
+            _write_full_record(rec)
+        print(emit_record(rec))
         return 0
 
     probe = probe_bringup(probe_timeout_s, cmd=probe_cmd)
@@ -361,7 +546,9 @@ def supervise(headline_only_run: bool = False, *, plans=None,
                 partial = _read_progress(progress_paths[:-1])
                 if partial.get("configs"):
                     result.setdefault("configs", partial["configs"])
-            print(json.dumps(result))
+            if worker_cmd is None:  # real run, not a fake-worker test
+                _write_full_record(result)
+            print(emit_record(result))
             # success: the progress stream duplicates the stdout record;
             # on failure the directory is left behind for debugging
             shutil.rmtree(progress_dir, ignore_errors=True)

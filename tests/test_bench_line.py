@@ -1,0 +1,236 @@
+"""The driver-line contract (VERDICT r3 item 1 — the round's top fix).
+
+The driver records only the LAST 2,000 bytes of bench.py's stdout.
+Rounds 1-3 each failed this contract a different way (crash, timeout,
+truncation: BENCH_r03.json has rc=0 but parsed=null because the ~2.1 KB
+line lost its head to the tail window). These tests pin the fix:
+
+  * emit_record() produces ONE line under LINE_BUDGET (< 2,000 with
+    headroom) for a maximal realistic record — every config populated,
+    attempt spreads, leg errors, clamp flags;
+  * the FULL record must json.loads from the line's last 2,000 bytes
+    (the exact driver capture);
+  * an adversarially bloated record (multi-KB error strings) is pruned
+    in priority order, still parses from the tail, and still carries
+    every config's headline value;
+  * every corrected GFLOPS figure is clamped at the 197 TFLOPS bf16
+    peak (VERDICT r3 item 2: the r3 artifact shipped 287,984 GFLOPS —
+    146% of physics).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+DRIVER_TAIL_BYTES = 2000
+
+
+def maximal_record():
+    """A record at least as field-heavy as any real run produces:
+    r3 driver values plus every r4 addition (vs_ref_avx_raw, clamp
+    flags, pipelined side legs, an error'd config and a leg error)."""
+    configs = {
+        "elementwise_add_mul_scale_n1000000": {
+            "value": 1004.6, "raw_value": 576.6, "unit": "Gop/s",
+            "effective_gbps": 2678.9, "vs_ref_avx": 200.5,
+            "vs_ref_avx_raw": 115.1},
+        "convolve_n65536_m127": {
+            "value": 4199.4, "raw_value": 2214.0, "unit": "MSamples/s",
+            "overlap_save_msps": 2055.5, "direct_shift_msps": 4199.4,
+            "direct_pallas_msps": 4640.0, "vs_ref_avx": 67.6,
+            "vs_ref_avx_raw": 35.7, "vs_ref_fft": 38.0},
+        "convolve_batched_b64_n16384_m127": {
+            "value": 4104.0, "raw_value": 2211.0, "unit": "MSamples/s",
+            "overlap_save_msps": 2932.6, "direct_shift_msps": 4104.0,
+            "vs_ref_avx": 65.6, "vs_ref_avx_raw": 35.3},
+        "dwt_db8_6level_n262144": {
+            "value": 7655.4, "raw_value": 4262.2, "unit": "MSamples/s",
+            "pallas_msps": 3687.1, "pallas_vs_xla": 0.482,
+            "vs_ref_avx": 39.4, "vs_ref_avx_raw": 22.0},
+        "normalize_peaks_b256_n4096": {
+            "value": 10489.0, "raw_value": 6733.6, "unit": "MSamples/s",
+            "vs_ref_avx": 69.6, "vs_ref_avx_raw": 44.7},
+        "flagship_pipeline_b128_n4096": {
+            "value": 32013.8, "raw_value": 22627.3, "unit": "MSamples/s"},
+        "stream_fir_swt_b256_chunk4096": {
+            "value": 13165.2, "raw_value": 9763.3, "unit": "MSamples/s"},
+        "welch_b64_n16384_nfft512": {
+            "value": 1959.9, "raw_value": 1778.7, "unit": "MSamples/s"},
+        "sosfilt_butter6_b256_n4096": {
+            "value": 3246.0, "raw_value": 1826.4, "unit": "MSamples/s",
+            "vs_ref_avx": 21.4, "vs_ref_avx_raw": 12.1},
+        "sosfilt_long_b16_n262144": {
+            "value": 728.9, "raw_value": 520.2, "unit": "MSamples/s",
+            "flat_msps": 296.7, "chunked_msps": 358.9,
+            "pipelined_msps": 728.9, "chunked_vs_flat": 1.21},
+        "welch_stream_b64_nfft512": {
+            "value": None, "raw_value": None, "unit": "MSamples/s",
+            "error": "leg failed to compile: Mosaic lowering error in "
+                     "some kernel with a moderately long explanation"},
+        "feed_io_b64_n16384": {"value": 4.9, "unit": "MSamples/s"},
+    }
+    return {
+        "metric": "matrix_multiply_f32_n4096", "value": 159074.3,
+        "unit": "GFLOPS", "vs_baseline": 1.615, "raw_value": 148908.2,
+        "attempts": [197000, 159074, 159038],
+        "pallas_gflops": 174936.2, "pallas_raw_gflops": 155306.5,
+        "pallas_attempts": [197000, 174844, 174936],
+        "pallas_vs_xla": 1.08, "clamped_fields": ["pallas_gflops",
+                                                  "attempts"],
+        "backend": "tpu", "vs_ref_avx": 14409.6, "vs_ref_avx_raw": 13488.4,
+        "leg_errors": {"pallas": "warm-up checksum non-finite"},
+        "configs": configs,
+    }
+
+
+def parse_driver_tail(line: str) -> dict:
+    """Exactly what the driver keeps: the last 2,000 bytes."""
+    tail = line.encode()[-DRIVER_TAIL_BYTES:].decode(errors="ignore")
+    return json.loads(tail)
+
+
+def test_maximal_record_fits_budget():
+    line = bench.emit_record(maximal_record())
+    assert "\n" not in line
+    assert len(line.encode()) <= bench.LINE_BUDGET, (
+        f"line is {len(line)}B > budget {bench.LINE_BUDGET}B")
+    rec = parse_driver_tail(line)
+    assert rec["metric"] == "matrix_multiply_f32_n4096"
+    assert rec["value"] == 159074.3
+    assert len(rec["configs"]) == 12
+    # compaction must not cost evidence: raw bounds, the headline's both
+    # speedup bases, the attempt spread, the clamp flags, and the
+    # per-config side legs all survive. This record is deliberately
+    # maximal (13th error'd config, leg errors, every optional field),
+    # so the ladder may shed its first two rungs — error truncation and
+    # the per-config vs_ref_avx_raw ratios, which the reader can derive
+    # from raw_value + REF_BASELINE.json — but nothing deeper.
+    assert rec.get("pruned", 0) <= 2
+    assert rec["raw_value"] == 148908.2
+    assert rec["vs_ref_avx_raw"] == 13488.4
+    assert rec["attempts"] == [197000, 159074, 159038]
+    assert rec["clamped_fields"] == ["pallas_gflops", "attempts"]
+    cfg = rec["configs"]["dwt_db8_6level_n262144"]
+    assert cfg["raw_value"] == 4262.2
+    assert cfg["pallas_msps"] == 3687.1      # side legs survive
+    assert cfg["vs_ref_avx"] == 39.4
+
+
+def test_unit_hoisting_roundtrip():
+    """Per-config MSamples/s is hoisted to one cfg_unit default; the
+    non-default unit (elementwise Gop/s) stays inline."""
+    rec = parse_driver_tail(bench.emit_record(maximal_record()))
+    assert rec["cfg_unit"] == "MSamples/s"
+    cfgs = rec["configs"]
+    assert "unit" not in cfgs["dwt_db8_6level_n262144"]
+    assert cfgs["elementwise_add_mul_scale_n1000000"]["unit"] == "Gop/s"
+
+
+def test_bloated_record_prunes_to_budget():
+    """Multi-KB error strings (the emit_failure path keeps a 2,000-char
+    stderr tail) must not push the line past the driver window; pruning
+    drops detail in priority order but never a config's value."""
+    rec = maximal_record()
+    rec["error"] = "x" * 2000
+    for cfg in rec["configs"].values():
+        cfg["note_like_field"] = "y" * 40
+    line = bench.emit_record(rec)
+    assert len(line.encode()) <= bench.LINE_BUDGET
+    parsed = parse_driver_tail(line)
+    assert parsed["pruned"] >= 1
+    assert parsed["value"] == 159074.3
+    assert len(parsed["configs"]) == 12
+    for cfg in parsed["configs"].values():
+        assert "value" in cfg
+
+
+def test_all_errored_record_still_fits():
+    """The emit_failure shape that defeats the ladder: every config
+    nulled with its own error string (tunnel death mid-suite). The
+    terminal rung must shed whole configs rather than ever exceed the
+    driver tail window."""
+    rec = maximal_record()
+    rec["error"] = "worker rc=1; stderr tail: " + "E" * 1200
+    for cfg in rec["configs"].values():
+        cfg["value"] = None
+        cfg.pop("raw_value", None)
+        cfg["error"] = ("jaxlib.xla_extension.XlaRuntimeError: UNAVAILABLE"
+                        ": TPU backend worker crashed or restarted " * 3)
+    line = bench.emit_record(rec)
+    assert len(line.encode()) <= bench.LINE_BUDGET
+    parsed = parse_driver_tail(line)
+    assert parsed["metric"] == "matrix_multiply_f32_n4096"
+    assert parsed["value"] == 159074.3          # headline survives
+    assert parsed["pruned"] >= 1
+    # any shed configs are counted, never silently absent
+    assert len(parsed["configs"]) + parsed.get("cfgs_dropped", 0) == 12
+
+
+def test_unit_tests_never_write_evidence_file(tmp_path):
+    """The full-record evidence file is written by REAL supervisor runs
+    only; fake-worker unit tests (worker_cmd injected) must never
+    clobber it with fabricated records."""
+    path = os.path.join(os.path.dirname(bench.__file__),
+                        "bench_full_last.json")
+    before = os.path.getmtime(path) if os.path.exists(path) else None
+    line = bench.emit_record(maximal_record(), budget=None)
+    bench.supervise(plans=[(False, 30, 0)],
+                    worker_cmd=lambda h, p: [sys.executable, "-c",
+                                             f"print({line!r})"],
+                    probe_cmd=[sys.executable, "-c", "print('ok')"],
+                    probe_timeout_s=10.0)
+    after = os.path.getmtime(path) if os.path.exists(path) else None
+    assert before == after
+
+
+def test_clamp_peak_fields():
+    rec = {"value": 266732.2,                    # the r3 first attempt
+           "raw_value": 148908.2,
+           "pallas_gflops": 287984.3, "pallas_raw_gflops": 155306.5,
+           "attempts": [266732.2, 159074.3],
+           "pallas_attempts": [287984.3, 174843.5]}
+    bench._clamp_peak_fields(rec)
+    peak = bench.V5E_BF16_PEAK_GFLOPS
+    assert rec["value"] == peak
+    assert rec["pallas_gflops"] == peak
+    assert rec["attempts"] == [peak, 159074.3]
+    assert rec["pallas_attempts"] == [peak, 174843.5]
+    assert rec["raw_value"] == 148908.2          # under peak: untouched
+    assert set(rec["clamped_fields"]) == {"value", "pallas_gflops",
+                                          "attempts", "pallas_attempts"}
+
+    def walk(v):
+        if isinstance(v, dict):
+            for x in v.values():
+                yield from walk(x)
+        elif isinstance(v, list):
+            for x in v:
+                yield from walk(x)
+        elif isinstance(v, (int, float)):
+            yield v
+    assert all(v <= peak for v in walk(rec))
+
+
+def test_supervisor_final_print_is_budgeted(capsys):
+    """End-to-end through supervise(): a fake worker emits a maximal
+    unpruned record (the worker hop has no tail window); the
+    supervisor's final stdout line must fit the driver capture."""
+    worker_line = bench.emit_record(maximal_record(), budget=None)
+
+    def worker_cmd(headline_only, progress_path):
+        return [sys.executable, "-c",
+                f"print({worker_line!r})"]
+
+    rc = bench.supervise(plans=[(False, 30, 0)], worker_cmd=worker_cmd,
+                         probe_cmd=[sys.executable, "-c", "print('ok')"],
+                         probe_timeout_s=10.0)
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    assert len(out[0].encode()) <= bench.LINE_BUDGET
+    rec = parse_driver_tail(out[0])
+    assert rec["value"] == 159074.3
+    assert len(rec["configs"]) == 12

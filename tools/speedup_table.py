@@ -63,14 +63,22 @@ def avx_table(bench_path=None):
     if rec.get("value") is not None:
         tpu[rec.get("metric", "matrix_multiply_f32_n4096")] = (
             rec["value"], rec.get("unit", ""))
+    # r4+ records hoist the ubiquitous per-config unit to one top-level
+    # default (bench.py emit_record line-budget compaction)
+    default_unit = rec.get("cfg_unit", "")
     for metric, cfg in (rec.get("configs") or {}).items():
         if isinstance(cfg, dict) and cfg.get("value") is not None:
-            tpu[metric] = (cfg["value"], cfg.get("unit", ""))
+            tpu[metric] = (cfg["value"], cfg.get("unit", default_unit))
     rows = []
     for metric, cfg in ref["configs"].items():
-        if metric not in tpu:
+        # _fft_proxy rows (the reference's FFT path, scipy-proxied)
+        # join against the same TPU measurement as their floor row —
+        # the suffix stays visible in the table as the ceiling label
+        join = (metric[:-len("_fft_proxy")]
+                if metric.endswith("_fft_proxy") else metric)
+        if join not in tpu:
             continue
-        tpu_v, unit = tpu[metric]
+        tpu_v, unit = tpu[join]
         # units match by construction; guard anyway so a mismatch is
         # visible in the table, not silently ratio'd away
         ref_unit = cfg.get("unit", "")

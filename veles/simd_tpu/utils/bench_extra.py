@@ -42,7 +42,7 @@ def _msps(st: dict, samples: int, digits: int = 1) -> dict:
     ``error`` so a null is never unexplained in the artifact."""
     rec = {"value": _rate(st["sec"], samples, digits),
            "raw_value": _rate(st["raw_sec"], samples, digits),
-           "unit": "MSamples/s", "vs_baseline": None}
+           "unit": "MSamples/s"}
     if st.get("error"):
         rec["error"] = st["error"]
     return rec
@@ -105,7 +105,7 @@ def bench_elementwise(scale=1):
     rec = {"metric": f"elementwise_add_mul_scale_n{n}",
            "value": gops(st["sec"]),
            "raw_value": gops(st["raw_sec"]),
-           "unit": "Gop/s", "vs_baseline": None,
+           "unit": "Gop/s",
            "effective_gbps":
                None if gbps is None else round(gbps / 1e3, 1)}
     if st.get("error"):
@@ -333,8 +333,7 @@ def bench_feed_io(scale=1):
         dt = time.perf_counter() - t0
     total = n_batches * batch * n
     return {"metric": f"feed_io_b{batch}_n{n}",
-            "value": round(total / dt / 1e6, 1), "unit": "MSamples/s",
-            "vs_baseline": None}
+            "value": round(total / dt / 1e6, 1), "unit": "MSamples/s"}
 
 
 def bench_stream(scale=1):
