@@ -119,7 +119,8 @@ def _prune_steps(rec: dict):
                  "flat_msps", "chunked_msps", "pallas_vs_xla",
                  "chunked_vs_flat", "pipelined_msps")
     # the irreducible per-config facts; everything else may be shed
-    essential = ("value", "raw_value", "unit", "vs_ref_avx", "error")
+    essential = ("value", "raw_value", "unit", "vs_ref_avx", "error",
+                 "floor_dom")
 
     def drop_cfg_keys(keys):
         for cfg in (rec.get("configs") or {}).values():
@@ -185,7 +186,7 @@ def emit_record(result: dict, budget: int | None = LINE_BUDGET) -> str:
     # trailing configs last (their names at least survive in
     # cfgs_dropped's count, and the full record file keeps everything).
     cfgs = rec.get("configs")
-    while (len(json.dumps(rec, separators=(",", ":"))) > budget - 20
+    while (len(json.dumps(rec, separators=(",", ":"))) > budget
            and cfgs):
         cfgs.pop(next(reversed(cfgs)))
         rec["cfgs_dropped"] = rec.get("cfgs_dropped", 0) + 1

@@ -293,21 +293,188 @@ def sosfiltfilt(x, sos, *, padtype=None, padlen=None, impl=None,
     return y
 
 
-def butter_sos(order, wn, btype="lowpass"):
-    """Butterworth design (host-side, float64 scipy): normalized cutoff
-    ``wn`` in (0, 1) as a fraction of Nyquist; returns (n_sections, 6)."""
-    from scipy.signal import butter
+# ---------------------------------------------------------------------------
+# Native filter design (NumPy float64, no scipy): the two designs the
+# framework's own ops depend on (sosfilt/sosfiltfilt defaults, decimate's
+# anti-alias filter, the bench/flagship configs) are self-contained —
+# closed-form analog prototype -> band transform -> bilinear transform ->
+# biquad pairing. Design is host-side f64 root-free arithmetic (the
+# prototypes are closed-form, so nothing here iterates); the device only
+# ever sees the resulting (n_sections, 6) coefficients. The long tail of
+# scipy design helpers further down remains declared host-side
+# delegation (see _design_passthrough).
+# ---------------------------------------------------------------------------
 
-    return butter(order, wn, btype=btype, output="sos")
+
+def _zpk_band_transform(z, p, k, wn, btype):
+    """Analog lowpass prototype (zeros, poles, gain) -> analog target
+    band at the pre-warped frequencies, as in the classical lp2lp /
+    lp2hp / lp2bp / lp2bs transforms. ``wn`` is the normalized digital
+    cutoff (fraction of Nyquist), scalar for low/highpass, a pair for
+    band filters; pre-warping matches the fs=2 bilinear step below."""
+    btype = {"low": "lowpass", "lp": "lowpass", "high": "highpass",
+             "hp": "highpass", "bp": "bandpass",
+             "bs": "bandstop", "stop": "bandstop",
+             "pass": "bandpass"}.get(btype, btype)
+    wn = np.atleast_1d(np.asarray(wn, np.float64))
+    if np.any(wn <= 0) or np.any(wn >= 1):
+        raise ValueError(f"wn must lie in (0, 1), got {wn}")
+    warped = 4.0 * np.tan(np.pi * wn / 2.0)   # 2*fs*tan(pi*wn/fs), fs=2
+    degree = len(p) - len(z)
+    if btype in ("lowpass", "highpass"):
+        if wn.size != 1:
+            raise ValueError(f"{btype} needs a scalar wn, got {wn}")
+        wo = warped[0]
+        if btype == "lowpass":
+            return z * wo, p * wo, k * wo ** degree
+        zt = np.append(wo / z, np.zeros(degree))
+        kt = k * np.real(np.prod(-z) / np.prod(-p))
+        return zt, wo / p, kt
+    if btype in ("bandpass", "bandstop"):
+        if wn.size != 2:
+            raise ValueError(f"{btype} needs wn=[low, high], got {wn}")
+        bw, wo = warped[1] - warped[0], np.sqrt(warped[0] * warped[1])
+        if btype == "bandpass":
+            zl, pl = z * bw / 2, p * bw / 2
+            zt = np.concatenate([zl + np.sqrt(zl ** 2 - wo ** 2 + 0j),
+                                 zl - np.sqrt(zl ** 2 - wo ** 2 + 0j)])
+            pt = np.concatenate([pl + np.sqrt(pl ** 2 - wo ** 2 + 0j),
+                                 pl - np.sqrt(pl ** 2 - wo ** 2 + 0j)])
+            return (np.append(zt, np.zeros(degree)), pt,
+                    k * bw ** degree)
+        zh, ph = (bw / 2) / z, (bw / 2) / p
+        zt = np.concatenate([zh + np.sqrt(zh ** 2 - wo ** 2 + 0j),
+                             zh - np.sqrt(zh ** 2 - wo ** 2 + 0j)])
+        pt = np.concatenate([ph + np.sqrt(ph ** 2 - wo ** 2 + 0j),
+                             ph - np.sqrt(ph ** 2 - wo ** 2 + 0j)])
+        zt = np.append(zt, np.concatenate([1j * wo * np.ones(degree),
+                                           -1j * wo * np.ones(degree)]))
+        kt = k * np.real(np.prod(-z) / np.prod(-p))
+        return zt, pt, kt
+    raise ValueError(f"unknown btype {btype!r}")
+
+
+def _zpk_bilinear(z, p, k):
+    """Analog -> digital via the bilinear transform at fs=2 (the fs the
+    pre-warp in :func:`_zpk_band_transform` assumes). Zeros gained from
+    the pole excess land at z=-1 (the analog zeros at infinity)."""
+    fs2 = 4.0
+    degree = len(p) - len(z)
+    zd = (fs2 + z) / (fs2 - z)
+    pd = (fs2 + p) / (fs2 - p)
+    zd = np.append(zd, -np.ones(degree))
+    kd = k * np.real(np.prod(fs2 - z) / np.prod(fs2 - p))
+    return zd, pd, kd
+
+
+def _split_conjugates(roots, tol=1e-8):
+    """[(pair), ...], [real, ...]: conjugate pairs matched greedily (the
+    designs here emit exact conjugates), reals sorted for determinism."""
+    roots = np.asarray(roots, np.complex128)
+    reals = sorted(r.real for r in roots[np.abs(roots.imag) <= tol])
+    upper = sorted(roots[roots.imag > tol], key=lambda r: (r.real, r.imag))
+    lower = list(roots[roots.imag < -tol])
+    pairs = []
+    for r in upper:
+        j = min(range(len(lower)), key=lambda i: abs(lower[i] - r.conj()))
+        c = lower.pop(j)
+        if abs(c - r.conj()) > 1e-6 * max(1.0, abs(r)):
+            raise ValueError("roots do not pair into conjugates")
+        pairs.append(r)
+    if lower:
+        raise ValueError("unmatched complex roots")
+    return pairs, reals
+
+
+def _zpk_to_sos(z, p, k):
+    """Pair conjugate/real roots into biquads: (n_sections, 6) float64.
+
+    Order-equivalence, not scipy-bit-equality: any pairing yields the
+    same cascade product (tests compare responses, and sosfilt feeds
+    sections identically). Sections are ordered by pole distance from
+    the unit circle, farthest first, so the most resonant section runs
+    last over the already-shaped signal (the usual overflow discipline);
+    the overall gain lands on the first section's numerator."""
+    zp, zr = _split_conjugates(z)
+    pp, pr = _split_conjugates(p)
+
+    def quads(pairs, reals):
+        out = [(np.array([1.0, -2 * r.real, abs(r) ** 2]), abs(abs(r) - 1))
+               for r in pairs]
+        reals = list(reals)
+        while len(reals) >= 2:
+            r1, r2 = reals.pop(), reals.pop()
+            out.append((np.array([1.0, -(r1 + r2), r1 * r2]),
+                        abs(abs(r1) - 1)))
+        if reals:
+            r = reals.pop()
+            out.append((np.array([1.0, -r, 0.0]), abs(abs(r) - 1)))
+        return out
+
+    num = quads(zp, zr)
+    den = quads(pp, pr)
+    if len(num) > len(den):
+        raise ValueError("more zero sections than pole sections")
+    num += [(np.array([1.0, 0.0, 0.0]), 0.0)] * (len(den) - len(num))
+    # most-resonant pole section (closest to the unit circle) last
+    order = np.argsort([-d[1] for d in den])
+    sos = np.zeros((len(den), 6), np.float64)
+    for row, idx in enumerate(order):
+        sos[row, :3] = num[idx][0]
+        sos[row, 3:] = den[idx][0]
+    sos[0, :3] *= k
+    return sos
+
+
+def _butter_prototype(order):
+    """Analog Butterworth prototype: ``order`` poles equi-spaced on the
+    left unit semicircle, no zeros, unit gain."""
+    if order < 1:
+        raise ValueError("order must be >= 1")
+    m = np.arange(-order + 1, order, 2)
+    p = -np.exp(1j * np.pi * m / (2 * order))
+    return np.zeros(0, np.complex128), p, 1.0
+
+
+def _cheby1_prototype(order, rp):
+    """Analog Chebyshev type-I prototype: poles on an ellipse set by the
+    passband ripple ``rp`` (dB), no zeros; closed form via sinh/cosh of
+    the inverse ripple parameter."""
+    if order < 1:
+        raise ValueError("order must be >= 1")
+    eps = np.sqrt(10.0 ** (0.1 * rp) - 1.0)
+    mu = np.arcsinh(1.0 / eps) / order
+    m = np.arange(-order + 1, order, 2)
+    theta = np.pi * m / (2 * order)
+    p = -np.sinh(mu + 1j * theta)
+    k = np.real(np.prod(-p))
+    if order % 2 == 0:
+        k /= np.sqrt(1.0 + eps * eps)
+    return np.zeros(0, np.complex128), p, k
+
+
+def butter_sos(order, wn, btype="lowpass"):
+    """Butterworth design, native float64 NumPy (no scipy): normalized
+    cutoff ``wn`` in (0, 1) as a fraction of Nyquist (a [low, high] pair
+    for band filters); returns (n_sections, 6). Closed-form prototype ->
+    pre-warped band transform -> bilinear -> biquad pairing; section
+    *pairing order* may differ from scipy's ``output="sos"`` but the
+    cascade response is identical (pinned by tests/test_iir.py against
+    the scipy frequency response)."""
+    z, p, k = _butter_prototype(order)
+    z, p, k = _zpk_band_transform(z, p, k, wn, btype)
+    return _zpk_to_sos(*_zpk_bilinear(z, p, k))
 
 
 def cheby1_sos(order, rp, wn, btype="lowpass"):
-    """Chebyshev type-I design (host-side, float64 scipy): passband
-    ripple ``rp`` dB, normalized cutoff ``wn``; returns (n_sections, 6).
-    The filter :func:`decimate` uses by default (scipy's choice)."""
-    from scipy.signal import cheby1
-
-    return cheby1(order, rp, wn, btype=btype, output="sos")
+    """Chebyshev type-I design, native float64 NumPy (no scipy):
+    passband ripple ``rp`` dB, normalized cutoff ``wn``; returns
+    (n_sections, 6). The filter :func:`decimate` uses by default
+    (scipy's choice). Same pipeline and same order-equivalence note as
+    :func:`butter_sos`."""
+    z, p, k = _cheby1_prototype(order, rp)
+    z, p, k = _zpk_band_transform(z, p, k, wn, btype)
+    return _zpk_to_sos(*_zpk_bilinear(z, p, k))
 
 
 def tf2sos(b, a):

@@ -127,7 +127,10 @@ def matmul(x, y, *, transpose_b=False, bm=512, bn=None, bk=None,
     pallas_call boundary so HBM->VMEM block traffic is half-width.
     ``precision="float32"`` keeps full-width operands through the dot —
     the in-kernel analogue of impl="xla" with precision="highest" — at
-    roughly half the MXU rate (and full-width block traffic). Tiles must
+    ~1/6 the MXU's bf16 rate as measured on chip (the forced
+    Precision.HIGHEST product decomposes into a multi-pass f32-exact
+    product — see the in-kernel comment) plus full-width block
+    traffic. Tiles must
     satisfy (bm*bk + bk*bn) * elem + bm*bn*4 (f32 accumulator) within the
     ~16 MB scoped VMEM budget including double buffers, or the kernel
     fails to allocate. ``bn``/``bk`` default per block width (explicit
