@@ -120,3 +120,23 @@ def test_bench_collect_secondary_shape(monkeypatch):
     out = bench_extra.collect_secondary(scale=1)
     assert out["tiny"]["value"] == 1.0
     assert "error" in out["boom"]
+
+
+def test_per_leg_iters():
+    """r4: iters may be {name: iters} — each leg times a chain of its
+    own length and corrects against a matching-length null floor (the
+    mxu convolve leg needs 16x the chain of its slow siblings)."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    carry = jnp.ones((4, 256), jnp.float32)
+    sts = chain_stats({"fast": lambda c: c * jnp.float32(1.0000001),
+                       "slow": lambda c: c @ jnp.ones((256, 256)) * 0 + c},
+                      carry, iters={"fast": 64, "slow": 8},
+                      reps=1, on_floor="nan", null_carry=carry[:1, :8])
+    for leg in ("fast", "slow"):
+        assert sts[leg]["raw_sec"] > 0
+    # raw_sec is per STEP: the fast leg's 64-step chain must not be
+    # divided by the slow leg's 8 (a shared-iters bug would inflate it)
+    assert sts["fast"]["raw_sec"] < sts["slow"]["raw_sec"] * 8

@@ -84,24 +84,37 @@ def test_convolve_commutative(rng):
 
 
 def test_selector_contract():
-    # Structure parity with convolve_initialize (convolve.c:328-366):
-    # small kernel -> direct (TPU shift-add beats the block FFT for
-    # h <= ~200 at any signal length); long signal with mid kernel ->
-    # overlap_save; balanced big -> fft; small -> direct.
+    # Structure parity with convolve_initialize (convolve.c:328-366),
+    # constants from the r4 on-chip sweep (policy table in
+    # ops/convolve.py): the banded-Toeplitz MXU direct path beats the
+    # block FFT up to h=1024 at any signal length; longer kernels on
+    # long signals take overlap_save (O(n) memory, within 2x); short
+    # signals with mid-size kernels stay on the band; only kernels past
+    # the explicit-direct band cap on short signals take fft.
     assert ops.select_algorithm(65536, 127) == "direct"
-    assert ops.select_algorithm(65536, 255) == "overlap_save"
-    assert ops.select_algorithm(8192, 8192) == "fft"
+    assert ops.select_algorithm(65536, 255) == "direct"
+    assert ops.select_algorithm(65536, 1024) == "direct"
+    assert ops.select_algorithm(65536, 1025) == "overlap_save"
     assert ops.select_algorithm(64, 16) == "direct"
-    assert ops.convolve_initialize(65536, 255).algorithm == "overlap_save"
+    assert ops.convolve_initialize(65536, 2048).algorithm == "overlap_save"
     assert ops.convolve_initialize(64, 16).algorithm == "direct"
-    # TPU-measured refinements (tools/tune_convolve.py table):
-    # large kernels never take the per-tap-unrolled direct path
-    assert ops.select_algorithm(4096, 1024) == "fft"
-    # batched block FFT wins as soon as there are >= 2 blocks to batch
-    assert ops.select_algorithm(16384, 255) == "overlap_save"
-    # mid-size signals above the unroll sweet spot but too short for
-    # overlap-save blocks take fft
-    assert ops.select_algorithm(4096, 300) == "fft"
+    # block FFT needs x > 2h and >= 2 blocks; met here
+    assert ops.select_algorithm(16384, 2048) == "overlap_save"
+    assert ops.select_algorithm(32768, 2048) == "overlap_save"
+    # below the overlap-save signal floor the band keeps mid kernels
+    assert ops.select_algorithm(8192, 2048) == "direct"
+    # balanced big shapes: band up to its explicit cap, fft beyond
+    assert ops.select_algorithm(8192, 8192) == "direct"
+    assert ops.select_algorithm(8192, 8193) == "fft"
+    assert ops.select_algorithm(4096, 1024) == "direct"
+    assert ops.select_algorithm(4096, 3000) == "direct"
+    # HBM bound: the band's frames matrix is ~(1 + h/128)x the signal,
+    # so giant signals with wide kernels keep the O(n) overlap-save
+    # path even though h <= _DIRECT_MAX_H (auto path must never OOM
+    # where r3's did not)
+    assert ops.select_algorithm(1 << 28, 1024) == "overlap_save"
+    assert ops.select_algorithm(1 << 28, 127) == "overlap_save"  # 2.1 GB
+    assert ops.select_algorithm(1 << 25, 127) == "direct"  # 2x of 128 MB
 
 
 def test_os_block_policy():
@@ -202,6 +215,60 @@ class TestAlgorithmEquivalenceFuzz:
         got = np.asarray(ops.cross_correlate(x, h))
         scale = np.abs(want).max() + 1.0
         np.testing.assert_allclose(got / scale, want / scale, atol=5e-5)
+
+
+class TestDirectMxuBand:
+    """The r4 production direct path: brute-force convolution as a
+    banded-Toeplitz matmul on the MXU (_convolve_direct_mxu_xla).
+    Frame/halo decomposition and the gather-free tap-band construction
+    must hold across frame-boundary shapes, halos spanning multiple
+    following frames (m - 1 > 128), batch, and the correlate
+    orientation — all at the f32 accuracy the direct contract promises
+    (Precision.HIGHEST inside)."""
+
+    @pytest.mark.parametrize("x_len,h_len",
+                             [(1, 1), (7, 3), (127, 64), (128, 128),
+                              (129, 127), (1000, 129), (500, 255),
+                              (300, 300), (4096, 1023)])
+    def test_differential_vs_oracle(self, rng, x_len, h_len):
+        from veles.simd_tpu.ops.convolve import _convolve_direct_mxu_xla
+        x = rng.normal(size=x_len).astype(np.float32)
+        h = (rng.normal(size=h_len) / h_len).astype(np.float32)
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        got = np.asarray(_convolve_direct_mxu_xla(x, h))
+        assert got.shape == want.shape
+        scale = np.abs(want).max() + 1e-30
+        np.testing.assert_allclose(got / scale, want / scale, atol=1e-5)
+
+    def test_batched_and_reverse(self, rng):
+        from veles.simd_tpu.ops.convolve import _convolve_direct_mxu_xla
+        x = rng.normal(size=(3, 2, 400)).astype(np.float32)
+        h = (rng.normal(size=127) / 127).astype(np.float32)
+        got = np.asarray(_convolve_direct_mxu_xla(x, h, reverse=True))
+        want = np.stack([[np.convolve(r.astype(np.float64),
+                                      h[::-1].astype(np.float64))
+                          for r in b] for b in x])
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=1e-5)
+
+    def test_is_the_selected_direct_path(self, rng):
+        """convolve(algorithm='direct') must route through the band (no
+        unroll ceiling: a 1023-tap explicit direct request compiles in
+        constant time and matches the oracle)."""
+        x = rng.normal(size=3000).astype(np.float32)
+        h = (rng.normal(size=1023) / 1023).astype(np.float32)
+        got = np.asarray(ops.convolve(x, h, algorithm="direct"))
+        want = ops.convolve(x, h, impl="reference")
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=1e-5)
+
+    def test_correlate_routes_through_band(self, rng):
+        x = rng.normal(size=2000).astype(np.float32)
+        h = rng.normal(size=200).astype(np.float32)
+        ref = ops.cross_correlate(x, h, impl="reference")
+        got = np.asarray(ops.cross_correlate(x, h, algorithm="direct"))
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(got / scale, ref / scale, atol=1e-5)
 
 
 class TestPallasDirect:

@@ -3,7 +3,7 @@
 The reference tuned its CPU constants empirically (convolve.c:328-366:
 overlap-save when x > 2h && x > 200; FFT when x > 350 on x86 / 50 on ARM).
 This script produces the TPU equivalents feeding ops/convolve.py's policy
-constants (_OS_MIN_X, _DIRECT_MAX_H, _DIRECT_MAX_X, _OS_BLOCK_MIN).
+constants (_OS_MIN_X, _DIRECT_MAX_H, _DIRECT_MXU_MAX_H, _OS_BLOCK_MIN).
 
 Timing uses utils/benchlib.py: every algorithm is an iters-long chained
 lax.scan, all candidates for one shape run interleaved in one process, and
@@ -55,8 +55,8 @@ def main():
             (rng.normal(size=h_len) / h_len).astype(np.float32))
         steps = {}
         for alg in ("direct", "fft", "overlap_save"):
-            if alg == "direct" and h_len > C._DIRECT_UNROLL_MAX_H:
-                continue  # per-tap unroll: compile time explodes
+            if alg == "direct" and h_len > C._DIRECT_MXU_MAX_H:
+                continue  # degenerate-conv fallback: not worth timing
             try:
                 handle = C.convolve_initialize(x_len, h_len, algorithm=alg)
             except ValueError:

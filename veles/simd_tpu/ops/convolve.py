@@ -3,11 +3,13 @@
 All three algorithms compute the full linear convolution (length x+h-1):
 
 * ``direct``       — the brute-force path (convolve.c:40-101). On TPU this
-  is a windowed-matmul: the h tap-diagonals are materialized with static
-  contiguous slices and contracted on the MXU (see _convolve_direct_xla;
-  the degenerate N=C=1 conv_general_dilated lowering compiles superlinearly
-  and runs <1 MS/s, so it is only the O(n)-memory fallback for oversized
-  explicit-direct requests).
+  is a banded-Toeplitz matmul on the MXU (_convolve_direct_mxu_xla):
+  128-sample output frames with an (m-1) halo contracted against one
+  (F+m-1, 128) tap-band matrix — measured 2-6x the former VPU shift-add
+  production path at full f32 accuracy, with compile time constant in m.
+  The shift-add form (_convolve_direct_xla) remains the scan-friendly
+  primitive (causal_fir) and hosts the degenerate conv_general_dilated
+  fallback for oversized explicit-direct requests.
 * ``fft``          — pad to M = next_pow2(x+h-1), batched rfft of {x, h},
   pointwise complex product, irfft (convolve.c:231-326 minus the FFTF
   dependency — XLA owns the FFT).
@@ -52,39 +54,70 @@ ALGORITHMS = ("direct", "fft", "overlap_save")
 # null-chain RTT correction — the axon tunnel's ~70 ms round trip swallows
 # small workloads, so every config is timed interleaved in one process and
 # the null chain's total is subtracted; tools/tune_convolve.py reproduces
-# the table).  MSamples/s at x=65536, 2026-07-30 (within-run ratios are
-# stable; absolute numbers drift ~2x with chip state):
+# the table). RAW wall-clock MSamples/s at x=65536, 2026-07-31 r4 session
+# (within-run ratios are stable; absolute numbers drift ~2x with chip
+# state):
 #
-#   h=63  : direct(shift-add) 1010   os 718
-#   h=127 : direct(shift-add)  900   os 727     (second run: 4071 vs 2051)
-#   h=255 : direct(shift-add)  670   os 718
-#   h=511 : direct(shift-add)  471   os 723
-#   h=1023: direct(shift-add)  303   os 734
+#   h=15   : direct(mxu-band) 4819   shift-add 5069   os 1520
+#   h=63   : direct(mxu-band) 4817   shift-add 3413   os 1521
+#   h=127  : direct(mxu-band) 4808   shift-add 2420   os 1521
+#   h=255  : direct(mxu-band) 4635   shift-add 1253   os 1521
+#   h=511  : direct(mxu-band) 4139   shift-add  736   os 1525
+#   h=1023 : direct(mxu-band) 1266                    os  836  fft 434
+#   h=4095 : direct(mxu-band)  906                    os  743  fft 435
+#   h=8191 : direct(mxu-band)  388                    os  472  fft 334
+#   batched (64, 16384) h=127: mxu 14342  shift 3488  os 2609
+#   long    n=1M        h=127: mxu  9418  shift 3046  os 3053
 #
 # Structure mirrors convolve.c:328-366; the constants are TPU-measured.
-# Four TPU-specific facts drive them: (a) the direct path is h fused
-# unit-stride shifted multiply-adds — one VPU pass, O(n) memory — and
-# beats the block FFT up to h ~ 200 at ANY signal length (both scale
-# linearly in x); (b) per-tap unrolling makes direct's compile time linear
-# in h, so very large kernels must never take it; (c) the batched block
-# FFT beats one full-length FFT once there are >= 2 blocks to batch;
-# (d) block extraction must be reshape/concat, never gather — the gather
-# formulation ran 9x slower (131 vs 1178 MS/s at x=1M).
+# The TPU facts behind them: (a) the direct path is a banded-Toeplitz
+# matmul on the MXU (_convolve_direct_mxu_xla) — it beats the batched
+# block FFT up to h ~ 4-8k and the old VPU shift-add everywhere past
+# h ~ 15, at constant compile time; (b) its frames matrix costs
+# ~(h/128)x the signal in HBM, so the auto-selector hands h > 1024 to
+# overlap-save (within 2x of mxu there, O(n) memory) and only explicit
+# algorithm="direct" requests ride the band past that, capped at
+# _DIRECT_MXU_MAX_H; (c) per-tap unrolling makes the VPU shift-add's
+# compile time linear in h — it remains the scan-friendly primitive
+# (causal_fir) and the impl="shift" measurement leg; (d) the batched
+# block FFT beats one full-length FFT once there are >= 2 blocks to
+# batch; (e) block/frame extraction must be reshape/concat, never
+# gather — TPU gathers serialize (measured 9x on overlap-save blocks,
+# 80x on the banded tap matrix).
 _OS_MIN_X = 16384       # >= 2 blocks of the 8192 floor: overlap-save wins
-_DIRECT_MAX_H = 192     # shift-add beats the block FFT below this, any x
-_DIRECT_UNROLL_MAX_H = 512   # unroll ceiling: above, conv-lowering fallback
-_DIRECT_MAX_X = 1024    # tiny signals are latency-bound; keep brute parity
+_DIRECT_MAX_H = 1024    # mxu-band beats the block FFT below this
+_DIRECT_MXU_MAX_H = 8192     # explicit-direct band cap (frames memory)
+_DIRECT_UNROLL_MAX_H = 512   # shift-add unroll ceiling (compile time)
+# auto-selector HBM bound for the band's frames matrix: the frames
+# expansion is ~(1 + h/128)x the signal, so huge signals with wide
+# kernels must not auto-ride it (n=2^28 f32 at h=1024 would build ~9 GB
+# of frames on a 16 GB chip). 2^27 f32 elements = 512 MB per signal;
+# batch multiplies this — callers batching large convolutions should
+# pass algorithm="overlap_save" explicitly where memory is tight.
+_DIRECT_MXU_MAX_ELEMS = 1 << 27
 _OS_BLOCK_MIN = 8192    # TPU-efficient FFT block floor (CPU policy was 4*h)
+_PALLAS_CONV_MAX_X = 2048    # hand-kernel gate: measured waiver in
+#                              pallas/convolve.py — parity only in the
+#                              latency-bound regime; longer signals
+#                              delegate to the production MXU band
+
+
+def _mxu_frames_elems(x_length: int, h_length: int) -> int:
+    """f32 elements the band path's frames matrix materializes."""
+    F = _MXU_FRAME
+    nblk = -(-(x_length + h_length - 1) // F)
+    return nblk * (F + h_length - 1)
 
 
 def select_algorithm(x_length: int, h_length: int) -> str:
     """Shape-driven algorithm choice (the convolve_initialize policy)."""
-    if h_length <= _DIRECT_MAX_H:
+    band_fits = _mxu_frames_elems(x_length, h_length) <= _DIRECT_MXU_MAX_ELEMS
+    if h_length <= _DIRECT_MAX_H and band_fits:
         return "direct"
     if x_length > 2 * h_length and x_length >= _OS_MIN_X:
         return "overlap_save"
-    if x_length <= _DIRECT_MAX_X and h_length <= _DIRECT_UNROLL_MAX_H:
-        return "direct"
+    if h_length <= _DIRECT_MXU_MAX_H and band_fits:
+        return "direct"  # short-signal mid-size kernels: band still wins
     return "fft"
 
 
@@ -152,6 +185,73 @@ def _convolve_direct_xla(x, h, reverse=False):
     for j in range(m):
         acc = acc + padded[..., j:j + n_out] * h[j]
     return acc
+
+
+#: banded-matmul frame width: 128 = one MXU tile of output columns per
+#: frame row. Measured fastest at m=127/x=65536 (F=128 raw 21.6 GS/s vs
+#: F=256 13.3 at HIGHEST); relative band overhead (F+m-1)/m shrinks as m
+#: grows, so one constant serves the whole direct range.
+_MXU_FRAME = 128
+
+
+@functools.partial(jax.jit, static_argnames=("reverse",))
+def _convolve_direct_mxu_xla(x, h, reverse=False):
+    """Brute-force convolution as a banded-Toeplitz matmul on the MXU.
+
+    The r1-r3 production direct path ran the m taps as shifted
+    multiply-adds on the VPU (now :func:`_convolve_direct_xla`, kept as
+    the scan-friendly primitive). This formulation moves the same O(n*m)
+    work to the MXU, where the chip's FLOPs actually live: frame the
+    padded signal into F=128-sample output blocks with an (m-1)-sample
+    halo — the overlap-save windowing with a matmul instead of an FFT —
+    and contract every frame against one (F+m-1, F) banded tap matrix
+    T[r, c] = h_corr[r - c]. Measured on the v5e at m=127, x=65536:
+    raw wall-clock bound 21.6 GS/s vs the shift-add path's 3.9 GS/s
+    (5.6x) at full f32 accuracy (Precision.HIGHEST, max rel err 1.6e-7
+    vs the f64 oracle; the TPU-default bf16 product measures 2e-3 and is
+    not offered — the direct algorithm's contract is f32, matching
+    the reference's brute kernel, src/convolve.c:40-101).
+
+    Band overhead is (F+m-1)/m of the true work (2x at m=127, 1.1x at
+    m=1023), and compile time is CONSTANT in m — no per-tap unroll — so
+    this path also serves arbitrarily large direct requests where the
+    shift-add trace would hang. Both T and the frames are built with
+    pad/tile/reshape/concat only: a gather here serializes the TPU and
+    measured 80x slower end-to-end (271 MS/s) when T was gathered
+    per-step inside a scan.
+
+    Batch-aware over leading axes of ``x``; ``reverse=True`` is the
+    cross-correlation orientation (correlate.py).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if not reverse:
+        h = h[::-1]  # correlation orientation: out[t] = sum_j h[j] xp[t+j]
+    n, m = x.shape[-1], h.shape[-1]
+    F = _MXU_FRAME
+    K = F + m - 1
+    out_len = n + m - 1
+    nblk = -(-out_len // F)
+    extra = -(-(m - 1) // F)       # following blocks the halo spans
+    lead = x.shape[:-1]
+    # xp[t] pairs with out[t - (m-1)]; frame k needs xp[kF : kF + K],
+    # so pad right until (nblk - 1 + extra + 1) blocks exist
+    xp = jnp.pad(x, [(0, 0)] * (x.ndim - 1)
+                 + [(m - 1, (nblk + extra) * F - n - (m - 1))])
+    shifts = [xp[..., j * F:(nblk + j) * F].reshape(lead + (nblk, F))
+              for j in range(extra + 1)]
+    frames = (jnp.concatenate(shifts, axis=-1)[..., :K]
+              if extra else shifts[0])  # extra == 0 iff m == 1 (K == F)
+    # gather-free banded Toeplitz: tile a (m+F)-periodic vector over an
+    # (F, K) view; row c, col r = v[(r - c) mod (m+F)] = h_corr[r-c] in
+    # the band, 0 elsewhere (the F trailing zeros absorb both oob sides)
+    v = jnp.concatenate([h, jnp.zeros(F, jnp.float32)])
+    S = jnp.tile(v, F)[:F * K].reshape(F, K)    # S[c, r] = T[r, c]
+    out = jax.lax.dot_general(
+        frames, S, (((frames.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(lead + (nblk * F,))[..., :out_len]
 
 
 @jax.jit
@@ -308,13 +408,26 @@ def convolve_initialize(x_length: int, h_length: int,
     out_length = x_length + h_length - 1
     if algorithm == "direct":
         if (resolve_impl(impl) == "pallas"
-                and h_length <= _DIRECT_UNROLL_MAX_H):
-            # same unroll ceiling as the XLA path: the kernel's tap loop
-            # is linear in h at trace time; oversized requests take the
-            # shared degenerate-conv fallback below
+                and h_length <= _DIRECT_UNROLL_MAX_H
+                and x_length <= _PALLAS_CONV_MAX_X):
+            # same unroll ceiling as the VPU shift-add (the kernel's tap
+            # loop is linear in h at trace time), plus the r4 measured
+            # size gate: past _PALLAS_CONV_MAX_X the kernel's VMEM
+            # stack cap makes it grid-overhead-bound (waiver in
+            # pallas/convolve.py) and the MXU band takes over
             from veles.simd_tpu.pallas.convolve import convolve_direct
             fn = functools.partial(convolve_direct, reverse=reverse)
+        elif (h_length <= _DIRECT_MXU_MAX_H
+              and _mxu_frames_elems(x_length, h_length)
+              <= _DIRECT_MXU_MAX_ELEMS):
+            # production direct: the banded-Toeplitz MXU matmul (policy
+            # table above; constant compile time, 2-6x the shift-add)
+            fn = functools.partial(_convolve_direct_mxu_xla,
+                                   reverse=reverse)
         else:
+            # oversized explicit-direct: the band's frames matrix would
+            # cost ~(h/128)x the signal in HBM; _convolve_direct_xla is
+            # O(n) memory (shift-add to h=512, degenerate conv beyond)
             fn = functools.partial(_convolve_direct_xla, reverse=reverse)
     elif algorithm == "fft":
         fft_length = fft_convolution_length(x_length, h_length)

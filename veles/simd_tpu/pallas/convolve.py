@@ -16,6 +16,22 @@ static offsets — same schedule, no recompilation per filter value.
 Gridded and batched exactly like pallas/wavelet.py: output axis tiled
 into VMEM-sized blocks whose input blocks overlap by the m-1 halo
 (element-indexed block dims), leading dims ride the batch grid axis.
+
+**Measured waiver (r4, on-chip, mirroring the DWT kernel's):** the
+runtime-tap VMEM stack cap (~1 MB blocks — each tap holds a live
+(bb, bl) temporary, so blocks shrink as 1/m) makes this kernel
+grid-overhead-bound on long signals: at m=127 it measured 72 / 103 /
+277 / 427 raw MS/s at n = 1k / 4k / 16k / 64k against the shift-add
+VPU path's 82 / 306 / 1000 / 2340 and the banded-MXU production
+path's 77 / 486 / 1212 / 4533. Parity holds only in the latency-bound
+regime (n <= ~2k). A taps-chunked accumulation grid cannot lift the
+cap at m ~ 127: Mosaic requires the shifted input-block offsets to be
+provably 128-aligned, so the chunk floor (128 taps) equals the whole
+filter. ``impl="pallas"`` therefore delegates signals past
+``_PALLAS_CONV_MAX_X`` (ops/convolve.py) to the production MXU band;
+the kernel stays Mosaic-validated (tpu_smoke) for the parity role —
+the reference ships a SIMD twin per op — and for the small-signal
+regime. Call :func:`convolve_direct` directly to force it.
 """
 
 from __future__ import annotations
@@ -26,11 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from veles.simd_tpu.pallas import use_interpret
 from veles.simd_tpu.pallas.wavelet import (
-    _LANES, _halo_spec, _pad_batch, _pad_to, _round_halo, _tile)
+    _LANES, _grouped_call, _pad_batch, _pad_to, _round_halo, _tile)
 
 
 def _fir_kernel(x_ref, taps_ref, o_ref, *, order, out_len):
@@ -61,19 +75,16 @@ def _fir_call(x_pad, taps, order, out_length):
     halo_pad = _round_halo(halo)
     out_len = -(-out_length // bl) * bl
     x2 = _pad_batch(_pad_to(x2, out_len + halo_pad), bb)
-    pb = x2.shape[0]
     kernel = functools.partial(_fir_kernel, order=order, out_len=bl)
-    out = pl.pallas_call(
-        kernel,
-        grid=(pb // bb, out_len // bl),
-        in_specs=[_halo_spec(bb, bl, halo_pad, pb // bb),
-                  pl.BlockSpec((1, order), lambda i, j: (0, 0))],
-        out_specs=pl.BlockSpec((bb, bl), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((pb, out_len), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=use_interpret(),
-    )(x2, taps.reshape(1, order))
+    # AOT scoped-VMEM output budget: the axon AOT pipeline places a
+    # multi-row pallas output wholly in scoped VMEM, so one call's
+    # output must stay under ~8 MiB — the same failure class (and the
+    # same shared _grouped_call policy) as the wavelet banks
+    # (ADVICE r3); the runtime taps ride as a replicated const operand.
+    out = _grouped_call(
+        (x2,), kernel, bb, bl, halo_pad, out_len, n_out=1,
+        const_inputs=(taps.reshape(1, order),),
+        const_specs=(pl.BlockSpec((1, order), lambda i, j: (0, 0)),))
     return out[:batch, :out_length].reshape(lead + (out_length,))
 
 

@@ -131,34 +131,48 @@ def _row_group(pb, bb, out_len, n_out=2):
     return max(bb, min(pb, rows // bb * bb))
 
 
-def _grouped_bank_call(inputs, kernel, bb, bl, halo_pad, out_len):
-    '''Run a dual-band kernel over batch-row groups sized by
-    `_row_group` and concatenate: shared by the DWT and SWT banks so
-    the VMEM-output budget lives in one place. ``inputs`` is a tuple of
-    (pb, in_len) arrays sharing the same halo spec.'''
+def _grouped_call(inputs, kernel, bb, bl, halo_pad, out_len, *, n_out=2,
+                  const_inputs=(), const_specs=()):
+    '''Run a kernel over batch-row groups sized by `_row_group` and
+    concatenate: shared by the DWT/SWT banks and the FIR kernel so the
+    VMEM-output budget lives in one place. ``inputs`` is a tuple of
+    (pb, in_len) arrays sharing the same halo spec; ``const_inputs`` /
+    ``const_specs`` carry operands replicated to every block (e.g. the
+    FIR runtime taps). Returns a tuple of ``n_out`` outputs (or the one
+    output bare when n_out == 1).'''
     pb = inputs[0].shape[0]
-    g = _row_group(pb, bb, out_len)
-    his, los = [], []
+    g = _row_group(pb, bb, out_len, n_out=n_out)
+    outs = [[] for _ in range(n_out)]
     for r0 in range(0, pb, g):
         rows = tuple(a[r0:r0 + g] for a in inputs)
         gr = rows[0].shape[0]
         spec = _halo_spec(bb, bl, halo_pad, gr // bb)
-        hi_g, lo_g = pl.pallas_call(
+        res = pl.pallas_call(
             kernel,
             grid=(gr // bb, out_len // bl),
-            in_specs=[spec] * len(rows),
-            out_specs=[pl.BlockSpec((bb, bl), lambda i, j: (i, j))] * 2,
+            in_specs=[spec] * len(rows) + list(const_specs),
+            out_specs=[pl.BlockSpec((bb, bl),
+                                    lambda i, j: (i, j))] * n_out,
             out_shape=[jax.ShapeDtypeStruct((gr, out_len),
-                                            jnp.float32)] * 2,
+                                            jnp.float32)] * n_out,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel")),
             interpret=use_interpret(),
-        )(*rows)
-        his.append(hi_g)
-        los.append(lo_g)
-    hi = his[0] if len(his) == 1 else jnp.concatenate(his, axis=0)
-    lo = los[0] if len(los) == 1 else jnp.concatenate(los, axis=0)
-    return hi, lo
+        )(*rows, *const_inputs)
+        if not isinstance(res, (list, tuple)):
+            res = [res]  # interpret mode unwraps singleton out_shapes
+        for k in range(n_out):
+            outs[k].append(res[k])
+    merged = tuple(o[0] if len(o) == 1 else jnp.concatenate(o, axis=0)
+                   for o in outs)
+    return merged if n_out > 1 else merged[0]
+
+
+def _grouped_bank_call(inputs, kernel, bb, bl, halo_pad, out_len):
+    """Dual-band (hi, lo) form of :func:`_grouped_call` — the DWT/SWT
+    bank signature."""
+    return _grouped_call(inputs, kernel, bb, bl, halo_pad, out_len,
+                         n_out=2)
 
 
 def _dwt_kernel(even_ref, odd_ref, hi_ref, lo_ref, *, taps_hi, taps_lo,

@@ -135,7 +135,8 @@ def bench_convolve(scale=1):
     import jax.numpy as jnp
     import numpy as np
 
-    from veles.simd_tpu.ops.convolve import (_convolve_direct_xla,
+    from veles.simd_tpu.ops.convolve import (_convolve_direct_mxu_xla,
+                                             _convolve_direct_xla,
                                              _convolve_overlap_save_xla,
                                              os_block_length)
 
@@ -152,26 +153,43 @@ def bench_convolve(scale=1):
         return out[:n]  # keep the carry shape fixed
 
     def step_direct(c):
-        # what the auto-selector actually picks for h=127 (shift-add)
+        # what the auto-selector picks for h=127 (r4: the banded-
+        # Toeplitz MXU matmul, policy table at ops/convolve.py)
+        return _convolve_direct_mxu_xla(c, h)[:n]
+
+    def step_shift(c):
+        # the r1-r3 production path, kept as a measured side leg
         return _convolve_direct_xla(c, h)[:n]
 
     def step_direct_pallas(c):
         from veles.simd_tpu.pallas.convolve import convolve_direct
         return convolve_direct(c, h)[:n]
 
-    # 8192 iters: the direct shift-add chain at 1024 steps measured
-    # inside the RTT floor on the r3 chip run (direct_shift_msps=None
-    # while slower legs resolved) — ~16 us/step needs a longer chain
+    # Per-leg chain lengths (r4): the mxu-band production leg runs
+    # ~1 us/step, so its raw bound needs ~131k steps to clear the
+    # ~120 ms tunnel floor; the 100x-slower side legs at that length
+    # would take minutes. Each leg corrects against a matching-length
+    # null floor (benchlib per-leg iters). The CPU smoke fallback
+    # (scale < 1, no tunnel floor to clear) shrinks the chains with the
+    # shapes.
+    def it(v):
+        return max(64, int(v * min(scale, 1)))
+
     sts = chain_stats({"os": step_os, "direct": step_direct,
+                       "shift": step_shift,
                        "direct_pallas": step_direct_pallas},
-                      x, iters=8192, on_floor="nan")
-    # headline value = best PRODUCTION path (what ops.convolve's selector
-    # can actually deliver); the opt-in hand kernel reports on the side
-    # production paths only (the opt-in hand kernel reports on the side)
+                      x, iters={"direct": it(131072), "os": it(8192),
+                                "shift": it(8192),
+                                "direct_pallas": it(4096)},
+                      on_floor="nan")
+    # headline value = best PRODUCTION path (what ops.convolve's
+    # selector can actually deliver); the opt-in hand kernel and the
+    # shift-add form report on the side
     best = _best_leg(sts, ("os", "direct"))
     rec = {"metric": f"convolve_n{n}_m{m}", **_msps(best, n),
            "overlap_save_msps": _rate(sts["os"]["sec"], n),
-           "direct_shift_msps": _rate(sts["direct"]["sec"], n),
+           "direct_mxu_msps": _rate(sts["direct"]["sec"], n),
+           "direct_shift_msps": _rate(sts["shift"]["sec"], n),
            "direct_pallas_msps": _rate(sts["direct_pallas"]["sec"], n)}
     _attach_leg_errors(rec, sts)
     return rec
@@ -185,7 +203,8 @@ def bench_convolve_batched(scale=1):
     import jax.numpy as jnp
     import numpy as np
 
-    from veles.simd_tpu.ops.convolve import (_convolve_direct_xla,
+    from veles.simd_tpu.ops.convolve import (_convolve_direct_mxu_xla,
+                                             _convolve_direct_xla,
                                              _convolve_overlap_save_xla,
                                              os_block_length)
 
@@ -202,16 +221,30 @@ def bench_convolve_batched(scale=1):
         return out[..., :n]
 
     def step_direct(c):
+        return _convolve_direct_mxu_xla(c, h)[..., :n]
+
+    def step_shift(c):
         return _convolve_direct_xla(c, h)[..., :n]
 
-    sts = chain_stats({"os": step_os, "direct": step_direct}, x, iters=512,
+    # Per-leg lengths (r4): the mxu-band leg runs ~28 us/step corrected
+    # on this shape — 8192 steps put its raw bound over the floor; the
+    # ~12x-slower side legs keep shorter chains (see bench_convolve,
+    # incl. the CPU-smoke scaling rationale)
+    def it(v):
+        return max(64, int(v * min(scale, 1)))
+
+    sts = chain_stats({"os": step_os, "direct": step_direct,
+                       "shift": step_shift},
+                      x, iters={"direct": it(8192), "os": it(1024),
+                                "shift": it(1024)},
                       null_carry=x[:1, :8], on_floor="nan")
-    best = _best_leg(sts)
+    best = _best_leg(sts, ("os", "direct"))
     return _attach_leg_errors(
         {"metric": f"convolve_batched_b{batch}_n{n}_m{m}",
          **_msps(best, batch * n),
          "overlap_save_msps": _rate(sts["os"]["sec"], batch * n),
-         "direct_shift_msps": _rate(sts["direct"]["sec"], batch * n)}, sts)
+         "direct_mxu_msps": _rate(sts["direct"]["sec"], batch * n),
+         "direct_shift_msps": _rate(sts["shift"]["sec"], batch * n)}, sts)
 
 
 def bench_dwt(scale=1):

@@ -36,7 +36,7 @@ def make_chain(step_fn, iters: int):
     return chain
 
 
-def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
+def chain_stats(steps: dict, carry, iters: "int | dict", reps: int = 3, *,
                 on_floor: str = "raise", null_carry=None,
                 attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
     """Per-step timing stats for each named step fn, RTT-corrected.
@@ -69,21 +69,39 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     (HBM-bound) configs pass a tiny ``null_carry`` so the floor captures
     only dispatch/scan/RTT overhead and the corrected time keeps the
     memory traffic.
+
+    ``iters`` may be a dict {name: iters} to size each leg's chain
+    independently (r4: the mxu-band convolve leg needs ~131k steps for
+    its raw bound to clear the floor, while timing the 100x-slower
+    pallas leg at that length would take minutes). One null chain runs
+    per distinct length, and every leg is corrected against the floor
+    of ITS length — floors are per-chain, not per-step, so lengths must
+    match for the subtraction to mean anything.
     """
     import math
 
     import jax
     import jax.numpy as jnp
 
-    chains = {"__null__": make_chain(
-        lambda c: jax.tree_util.tree_map(
-            lambda leaf: leaf * jnp.asarray(1.0000001, leaf.dtype), c),
-        iters)}
+    def leg_iters(name):
+        return iters[name] if isinstance(iters, dict) else iters
+
+    def null_name(it):
+        return f"__null__{it}"
+
+    def _null(c):
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf * jnp.asarray(1.0000001, leaf.dtype), c)
+
+    lengths = sorted({leg_iters(name) for name in steps})
+    chains = {null_name(it): make_chain(_null, it) for it in lengths}
+    nulls = set(chains)
     for name, fn in steps.items():
-        chains[name] = make_chain(fn, iters)
+        chains[name] = make_chain(fn, leg_iters(name))
     carries = {name: carry for name in chains}
     if null_carry is not None:
-        carries["__null__"] = null_carry
+        for it in lengths:
+            carries[null_name(it)] = null_carry
 
     failed = {}
     causes = {}
@@ -94,14 +112,14 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
             # one leg failing to compile/run (e.g. the FFT leg while the
             # tunnel's FFT capability is out — observed r3) must not
             # zero the whole config: record it and time the rest
-            if name == "__null__":
+            if name in nulls:
                 raise  # the floor chain is load-bearing for every leg
             failed[name] = f"{type(e).__name__}: {e}"[:500]
             causes[name] = e
             del chains[name]
             continue
         if not math.isfinite(value):
-            if name == "__null__":
+            if name in nulls:
                 raise RuntimeError(
                     f"non-finite checksum from the null chain: {value}")
             # same isolation as a raise: a leg computing garbage (r3:
@@ -120,6 +138,15 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
         name, msg = next(iter(failed.items()))
         raise RuntimeError(
             f"leg '{name}' failed: {msg}") from causes.get(name)
+
+    # a null chain whose only leg failed at warm-up would still be
+    # timed reps*attempts times (each rep >= the tunnel floor) feeding
+    # a floors series nobody reads — drop orphaned lengths
+    live = {leg_iters(name) for name in chains if name not in nulls}
+    for it in lengths:
+        if it not in live and null_name(it) in chains:
+            del chains[null_name(it)]
+    lengths = sorted(live)
 
     # ``attempts`` spaced groups of ``reps`` reuse the compiled chains —
     # cheap resilience against multi-second chip/tunnel state drift
@@ -141,9 +168,10 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     # on the total alone keeps the paired floor sample unbiased (a
     # min-over-paired-diffs would preferentially pick high-floor
     # outliers and inflate rates again).
-    floors = totals.pop("__null__")
+    floors_by_len = {it: totals.pop(null_name(it)) for it in lengths
+                     if null_name(it) in totals}
 
-    def corrected(series, lo, hi):
+    def corrected(series, floors, lo, hi):
         """Best paired-floor-corrected total in series[lo:hi], or NaN when
         that window is floored (same criterion as the headline value)."""
         idx = min(range(lo, hi), key=series.__getitem__)
@@ -156,15 +184,17 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
     out = {}
     n_attempts = max(attempts, 1)
     for name, series in totals.items():
-        best_diff, idx = corrected(series, 0, len(series))
+        it = leg_iters(name)
+        floors = floors_by_len[it]
+        best_diff, idx = corrected(series, floors, 0, len(series))
         best_total, best_floor = series[idx], min(floors)
         # per-attempt corrected values: the spread across chip-state
         # drift that a single clamped point estimate hides
         attempt_sec = []
         for a in range(n_attempts):
             lo, hi = a * reps, (a + 1) * reps
-            d, _ = corrected(series, lo, hi)
-            attempt_sec.append(d / iters)
+            d, _ = corrected(series, floors, lo, hi)
+            attempt_sec.append(d / it)
         if best_diff != best_diff:  # floored overall
             msg = (f"config '{name}' ({best_total * 1e3:.1f} ms) is "
                    f"indistinguishable from the RTT floor "
@@ -173,13 +203,13 @@ def chain_stats(steps: dict, carry, iters: int, reps: int = 3, *,
             if on_floor == "raise":
                 raise RuntimeError(msg)
             out[name] = {"sec": float("nan"),
-                         "raw_sec": best_total / iters,
-                         "floor_sec": floors[idx] / iters,
+                         "raw_sec": best_total / it,
+                         "floor_sec": floors[idx] / it,
                          "attempt_sec": attempt_sec}
         else:
-            out[name] = {"sec": best_diff / iters,
-                         "raw_sec": best_total / iters,
-                         "floor_sec": floors[idx] / iters,
+            out[name] = {"sec": best_diff / it,
+                         "raw_sec": best_total / it,
+                         "floor_sec": floors[idx] / it,
                          "attempt_sec": attempt_sec}
     for name, msg in failed.items():
         out[name] = {"sec": float("nan"), "raw_sec": float("nan"),
