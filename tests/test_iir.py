@@ -55,6 +55,24 @@ class TestSosfilt:
         want = ref_iir.sosfilt(x, sos)
         np.testing.assert_allclose(auto, want, rtol=1e-4, atol=1e-4)
 
+    def test_blockbasis_many_blocks_and_states(self, rng):
+        """The r4 block-basis superposition path (one parallel tree over
+        all blocks + 2-vector state chain): many blocks, a sub-chunk
+        remainder, and a NONZERO incoming state — the superposition
+        correction and the state chain must reproduce the flat tree
+        exactly (states) / to reassociation tolerance (samples)."""
+        from veles.simd_tpu.ops.iir import _sosfilt_xla
+        sos = np.asarray(_sos(6, 0.25), np.float32)
+        S = sos.shape[0]
+        x = rng.normal(size=(3, 19 * 1024 + 357)).astype(np.float32)
+        s0 = (rng.normal(size=(S, 2)) * 0.1).astype(np.float32)
+        y_bb, sf_bb = _sosfilt_xla(x, sos, s0, S, chunk=1024)
+        y_fl, sf_fl = _sosfilt_xla(x, sos, s0, S, chunk=0)
+        np.testing.assert_allclose(np.asarray(y_bb), np.asarray(y_fl),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(sf_bb), np.asarray(sf_fl),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_chunked_final_state_matches_flat(self, rng):
         """Streaming correctness hinges on the scanned-out final state:
         chain two chunked whole-signal calls via iir_stream_step and

@@ -201,6 +201,66 @@ def _psd_stft(x, w, nfft, hop, detrend_kind):
     return jnp.fft.rfft(fr * w, axis=-1)
 
 
+#: power-only PSD path: below this nfft the DFT runs as two real
+#: matmuls on the MXU instead of an FFT on the VPU — welch needs only
+#: |X|^2, so the phase split costs nothing. Measured on-chip at
+#: (64, 16384) nfft=512 hop=128: 6,673 MS/s corrected (raw 6,027) vs
+#: the batched-rfft path's 1,967 (raw 1,868) = 3.4x, f32-exact
+#: (Precision.HIGHEST, 1.6e-7 vs the f64 oracle; the TPU-default bf16
+#: product measures 2e-3 and is not used). The matmul is O(nfft^2) vs
+#: the FFT's O(nfft log nfft), but the MXU's FLOP advantage carries it
+#: far past every bench shape; the cap keeps asymptotics honest.
+_PSD_MXU_MAX_NFFT = 2048
+
+
+@functools.lru_cache(maxsize=8)
+def _dft_matrices(nfft):
+    """Cached host (cos, sin) rDFT matrices (nfft, nfft//2+1) float32.
+    Built in float64 with the phase reduced mod nfft before the 2*pi
+    scale, so large k*f products lose no precision (the ops/czt.py
+    chirp-phase discipline). Cached per nfft as NUMPY arrays — eager
+    callers looping welch over records must not redo the trig, and a
+    device/tracer value must never be cached (a jit-traced first call
+    would leak its tracer into every later caller)."""
+    k = np.arange(nfft, dtype=np.float64)[:, None]
+    f = np.arange(nfft // 2 + 1, dtype=np.float64)[None, :]
+    ph = 2.0 * np.pi * ((k * f) % nfft) / nfft
+    return (np.cos(ph).astype(np.float32),
+            np.sin(ph).astype(np.float32))
+
+
+def _psd_power_frames(fr_windowed, nfft):
+    """|DFT|^2 of windowed frames via two MXU matmuls -> (..., F,
+    nfft//2+1)."""
+    cos_np, sin_np = _dft_matrices(nfft)
+    cos_m, sin_m = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    dn = (((fr_windowed.ndim - 1,), (0,)), ((), ()))
+    re = jax.lax.dot_general(fr_windowed, cos_m, dn,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    im = jax.lax.dot_general(fr_windowed, sin_m, dn,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    return re * re + im * im
+
+
+def _psd_power(x, w, nfft, hop, detrend_kind):
+    """Mean per-frame power spectrum (unnormalized): the shared core of
+    welch/periodogram. Small transforms ride the MXU (see
+    _PSD_MXU_MAX_NFFT); larger ones the batched rfft."""
+    if w.shape[-1] != nfft:
+        raise ValueError(f"window length {w.shape[-1]} != nfft {nfft}")
+    fr = frame(jnp.asarray(x, jnp.float32), nfft, hop)
+    if detrend_kind is not None:
+        fr = _detrend_xla(fr, detrend_kind)
+    if nfft <= _PSD_MXU_MAX_NFFT:
+        p = _psd_power_frames(fr * w, nfft)
+    else:
+        s = jnp.fft.rfft(fr * w, axis=-1)
+        p = jnp.abs(s) ** 2
+    return jnp.mean(p, axis=-2)
+
+
 def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
           detrend=None, impl=None):
     """Welch power spectral density -> float32 (..., nfft//2+1): the
@@ -219,9 +279,8 @@ def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
     hop = nfft // 4 if hop is None else hop
     w = hann_window(nfft) if window is None else \
         jnp.asarray(window, jnp.float32)
-    s = _psd_stft(x, w, nfft, hop, detrend)
-    return (jnp.mean(jnp.abs(s) ** 2, axis=-2) /
-            (jnp.sum(w * w) * nfft)).astype(jnp.float32)
+    p = _psd_power(x, w, nfft, hop, detrend)
+    return (p / (jnp.sum(w * w) * nfft)).astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))

@@ -476,11 +476,14 @@ def bench_iir(scale=1):
 
 
 def bench_iir_long(scale=1):
-    """Long-signal IIR, flat vs blocked associative scan (VERDICT r2
-    item 5): 16 signals x 262144 samples through butterworth-6. The flat
-    tree broadcasts the 2x2 companion matrix to every sample; the
-    blocked form scans 4096-sample chunks sequentially — this config
-    records both so the formulation choice is a measured fact."""
+    """Long-signal IIR: 16 signals x 262144 samples through
+    butterworth-6. The production path (r4) is the block-basis
+    superposition scan — every 4096-sample block of every batch row in
+    ONE parallel tree per section, inter-block states chained by a tiny
+    2-vector scan (ops/iir.py:_section_scan_blockbasis_T; measured
+    12.9x the r3 sequential-block form, 31x the flat 262k-level tree).
+    The flat tree stays as the measured side leg so the formulation
+    choice remains a recorded fact."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -499,10 +502,16 @@ def bench_iir_long(scale=1):
                                chunk=chunk) * jnp.float32(0.999)
         return step
 
-    # 16 iters: ~146 ms/step measured on-chip for both formulations; the
-    # worker watchdog caps a single execution at ~60 s (see bench_iir).
+    # Per-leg chains: block-basis runs ~0.9 ms/step on-chip (512 steps
+    # = ~0.5 s device, raw bound over the tunnel floor); the flat tree
+    # at ~29 ms/step keeps 16 (the worker watchdog caps one execution
+    # at ~60 s — the r3 bench crash).
+    def it(v):
+        return max(8, int(v * min(scale, 1)))
+
     sts = chain_stats({"flat": make(0), "chunked": make(4096)}, x,
-                      iters=16, on_floor="nan", null_carry=x[:1, :8])
+                      iters={"flat": it(16), "chunked": it(512)},
+                      on_floor="nan", null_carry=x[:1, :8])
     best = _best_leg(sts)
     rec = {"metric": f"sosfilt_long_b{batch}_n{n}",
            **_msps(best, batch * n),

@@ -36,7 +36,7 @@ def make_chain(step_fn, iters: int):
     return chain
 
 
-def chain_stats(steps: dict, carry, iters: "int | dict", reps: int = 3, *,
+def chain_stats(steps: dict, carry, iters: int | dict, reps: int = 3, *,
                 on_floor: str = "raise", null_carry=None,
                 attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
     """Per-step timing stats for each named step fn, RTT-corrected.
@@ -218,7 +218,7 @@ def chain_stats(steps: dict, carry, iters: "int | dict", reps: int = 3, *,
     return out
 
 
-def chain_times(steps: dict, carry, iters: int, reps: int = 3, *,
+def chain_times(steps: dict, carry, iters: int | dict, reps: int = 3, *,
                 on_floor: str = "raise", null_carry=None,
                 attempts: int = 1, attempt_gap_s: float = 0.0) -> dict:
     """{name: corrected seconds per step} — see chain_stats for details."""
