@@ -102,6 +102,15 @@ def overlap_add(frames, hop: int):
 
 @functools.partial(jax.jit, static_argnames=("nfft", "hop"))
 def _stft(x, window, nfft, hop):
+    # Stays on the VPU rfft at every size, deliberately: the MXU
+    # DFT-matmul that carries the POWER estimators (see
+    # _psd_power_frames) reassociates the per-bin reduction with the
+    # frame-batch shape, which would break two contracts the complex
+    # transform owns — the streaming STFT's bit-exact match to the
+    # whole-signal op (different frame counts per call) and the exact
+    # ISTFT round-trip (measured 2e-4 at overlap edges under the
+    # matmul vs ~1e-6 with the rfft pair). Power paths have no such
+    # contracts, so they take the 3.4x; phases keep the FFT.
     frames = frame(jnp.asarray(x, jnp.float32), nfft, hop)
     return jnp.fft.rfft(frames * window, axis=-1)
 
@@ -169,13 +178,20 @@ def istft(spec, *, nfft: int = 512, hop: int | None = None, window=None,
 
 def spectrogram(x, *, nfft: int = 512, hop: int | None = None, window=None,
                 impl=None):
-    """Power spectrogram |STFT|^2 -> float32 (..., n_frames, nfft//2+1)."""
+    """Power spectrogram |STFT|^2 -> float32 (..., n_frames, nfft//2+1).
+
+    Power-only, so transforms at nfft <= 2048 ride the MXU DFT matmul
+    (the welch path's measured 3.4x; larger transforms take the
+    batched rfft) — the complex :func:`stft` keeps the VPU rfft for
+    its exactness contracts (streaming bit-match, ISTFT round-trip)."""
     if resolve_impl(impl) == "reference":
         return _ref.spectrogram(x, nfft=nfft, hop=hop, window=window)
-    # the resolved choice propagates: an explicit impl= must not be
-    # overridden by the ambient switch in the inner call
-    s = stft(x, nfft=nfft, hop=hop, window=window, impl="xla")
-    return (jnp.abs(s) ** 2).astype(jnp.float32)
+    hop = nfft // 4 if hop is None else hop
+    w = hann_window(nfft) if window is None else \
+        jnp.asarray(window, jnp.float32)
+    if w.shape[-1] != nfft:
+        raise ValueError(f"window length {w.shape[-1]} != nfft {nfft}")
+    return _spectrogram_xla(x, w, nfft, hop)
 
 
 def _psd_detrend_kind(detrend):
@@ -244,6 +260,15 @@ def _psd_power_frames(fr_windowed, nfft):
     return re * re + im * im
 
 
+def _frame_power(fr_windowed, nfft):
+    """|DFT|^2 of windowed frames — the ONE home of the MXU-vs-rfft
+    power policy, shared by welch/periodogram (via _psd_power) and
+    spectrogram so the estimators cannot diverge."""
+    if nfft <= _PSD_MXU_MAX_NFFT:
+        return _psd_power_frames(fr_windowed, nfft)
+    return jnp.abs(jnp.fft.rfft(fr_windowed, axis=-1)) ** 2
+
+
 def _psd_power(x, w, nfft, hop, detrend_kind):
     """Mean per-frame power spectrum (unnormalized): the shared core of
     welch/periodogram. Small transforms ride the MXU (see
@@ -253,12 +278,16 @@ def _psd_power(x, w, nfft, hop, detrend_kind):
     fr = frame(jnp.asarray(x, jnp.float32), nfft, hop)
     if detrend_kind is not None:
         fr = _detrend_xla(fr, detrend_kind)
-    if nfft <= _PSD_MXU_MAX_NFFT:
-        p = _psd_power_frames(fr * w, nfft)
-    else:
-        s = jnp.fft.rfft(fr * w, axis=-1)
-        p = jnp.abs(s) ** 2
-    return jnp.mean(p, axis=-2)
+    return jnp.mean(_frame_power(fr * w, nfft), axis=-2)
+
+
+@functools.partial(jax.jit, static_argnames=("nfft", "hop"))
+def _spectrogram_xla(x, w, nfft, hop):
+    # one compiled kernel: framing, window, transform, |.|^2 fuse, and
+    # the DFT matrices constant-fold into the executable (the _stft
+    # pattern — an eager chain would re-upload them every call)
+    fr = frame(jnp.asarray(x, jnp.float32), nfft, hop) * w
+    return _frame_power(fr, nfft).astype(jnp.float32)
 
 
 def welch(x, *, nfft: int = 512, hop: int | None = None, window=None,
