@@ -136,11 +136,76 @@ def _swt_bank(x_ext, filters, stride, length):
     return zhi, zlo
 
 
+#: decimated-bank MXU policy: levels with at least this many OUTPUT
+#: samples per band run the stride-2 banded matmul (_dwt_bank_mxu);
+#: smaller levels keep the fused VPU shift-add bank (latency-bound
+#: there, and the frames copy would be pure overhead). Measured r4
+#: on-chip at (262144,) db8 6-level: the shipped auto dispatch (MXU
+#: above this threshold, VPU below) 9,800 MS/s corrected / 6,572 raw
+#: vs the all-VPU bank's 7,789 / 5,561; an all-MXU variant (small
+#: levels included) measured 9,190 — the small-level VPU fallback is
+#: worth ~6%.
+_DWT_MXU_MIN_HALF = 4096
+_DWT_F = 128  # output samples per band per frame row (one MXU tile)
+
+
+def _dwt_bank_mxu(x_ext, filters, half):
+    """Decimated dual bank as ONE banded matmul on the MXU.
+
+    out_hi[d] = sum_j f_hi[j] x_ext[2d + j] (and lo alike): frame the
+    extended signal into 2F-sample stride-2 input blocks with an (m-1)
+    halo and contract against a (2F, K) two-band matrix whose row c is
+    the filter placed at offset 2c — the convolve banded-Toeplitz
+    schedule (ops/convolve.py:_convolve_direct_mxu_xla) with a
+    stride-2 diagonal and both bands sharing the frames. The band
+    matrix is built gather-free from the runtime filter planes by the
+    periodic-tile trick with period K + 2 (row stride K == -2 mod
+    period gives exactly the 2-per-row shift; the 2F + 1 trailing
+    zeros absorb both out-of-band sides, single-wrap because
+    2F - 2 < K + 2). Precision.HIGHEST: the bank's contract is f32
+    (the reference's dual _mm256_dp_ps is f32)."""
+    m = filters.shape[-1]
+    F = _DWT_F
+    K = 2 * F + m - 1
+    lead = x_ext.shape[:-1]
+    nblk = -(-half // F)
+    extra = -(-(m - 1) // (2 * F))  # halo blocks (1 for every table m)
+    xp = jnp.pad(x_ext, [(0, 0)] * (x_ext.ndim - 1)
+                 + [(0, (nblk + extra) * 2 * F + m - 1
+                     - x_ext.shape[-1])])
+    shifts = [xp[..., j * 2 * F:(nblk + j) * 2 * F]
+              .reshape(lead + (nblk, 2 * F)) for j in range(extra + 1)]
+    frames = jnp.concatenate(shifts, axis=-1)[..., :K]
+
+    def band(f):
+        v = jnp.concatenate([f, jnp.zeros(2 * F + 1, jnp.float32)])
+        return jnp.tile(v, F)[:F * K].reshape(F, K)
+
+    S = jnp.concatenate([band(filters[0]), band(filters[1])], axis=0)
+    out = jax.lax.dot_general(
+        frames, S, (((frames.ndim - 1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    hi = out[..., :F].reshape(lead + (nblk * F,))[..., :half]
+    lo = out[..., F:].reshape(lead + (nblk * F,))[..., :half]
+    return hi, lo
+
+
+def _dwt_bank_auto(x_ext, filters, half):
+    """The ONE home of the VPU-vs-MXU decimated-bank dispatch, shared
+    by the single-device path and the per-shard kernel of
+    parallel.ops.wavelet_apply_sharded (whose shards are exactly the
+    large-half regime the MXU band wins)."""
+    if half >= _DWT_MXU_MIN_HALF:
+        return _dwt_bank_mxu(x_ext, filters, half)
+    return _dwt_bank(x_ext, filters, half)
+
+
 @functools.partial(jax.jit, static_argnames=("ext",))
 def _wavelet_apply_xla(src, filters, ext):
     src = jnp.asarray(src, jnp.float32)
     x = _extend(src, filters.shape[-1], ext)
-    return _dwt_bank(x, filters, src.shape[-1] // 2)
+    return _dwt_bank_auto(x, filters, src.shape[-1] // 2)
 
 
 @functools.partial(jax.jit, static_argnames=("ext", "stride"))

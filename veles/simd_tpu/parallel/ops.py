@@ -16,7 +16,7 @@ from jax.sharding import PartitionSpec as P
 from veles.simd_tpu import wavelet_data
 from veles.simd_tpu.ops.wavelet import (EXTENSION_CONSTANT, EXTENSION_MIRROR,
                                         EXTENSION_PERIODIC, EXTENSION_ZERO,
-                                        _dwt_bank, _swt_bank)
+                                        _dwt_bank_auto, _swt_bank)
 from veles.simd_tpu.parallel.alltoall import alltoall_map
 from veles.simd_tpu.parallel.halo import halo_map
 
@@ -89,7 +89,9 @@ def wavelet_apply_sharded(x, wavelet_type="daubechies", order=8,
 
     def local(x_ext, filters):
         half = (x_ext.shape[-1] - order) // 2
-        hi_b, lo_b = _dwt_bank(x_ext, filters, half)
+        # shared VPU-vs-MXU dispatch: sharding is only worthwhile for
+        # large signals, which is exactly the banded-matmul regime
+        hi_b, lo_b = _dwt_bank_auto(x_ext, filters, half)
         return jnp.concatenate([hi_b, lo_b], axis=-1)
 
     fn = halo_map(local, mesh, axis, right=order, boundary=boundary,

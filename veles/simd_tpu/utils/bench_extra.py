@@ -280,10 +280,13 @@ def bench_dwt(scale=1):
                     + acc / n)
         return six_level
 
-    # the polyphase DWT runs ~70 us/transform; thousands of chained steps
-    # are needed for device time to dominate the ~100 ms tunnel RTT
-    # floor. Both impls share one interleaved floor so the ratio is
-    # meaningful (VERDICT r1 item 3: pallas within 2x of xla on chip).
+    # the DWT runs ~27-70 us/transform; thousands of chained steps are
+    # needed for device time to dominate the ~100 ms tunnel RTT floor.
+    # Both impls share one interleaved floor so the ratio is meaningful
+    # (VERDICT r1 item 3). r4 note: the xla leg's big levels now ride
+    # the stride-2 MXU band (_dwt_bank_mxu), so pallas_vs_xla compares
+    # the hand VPU kernel against the MXU production path — the waiver
+    # ratio's denominator moved with production, as it should.
     sts = chain_stats({"xla": make_six_level("xla"),
                        "pallas": make_six_level("pallas")},
                       x, iters=4096, on_floor="nan")
