@@ -376,3 +376,16 @@ class TestConvolve2DFuzz:
             np.testing.assert_allclose(
                 got / scale, want / scale, atol=5e-5,
                 err_msg=f"seed={seed} x=({hh},{ww}) h=({kh},{kw}) {alg}")
+
+
+def test_selector_batch_aware_memory_bound():
+    """The one-shot convolve scales the band's frames-memory bound by
+    the batch (ROUND4_NOTES open item): a batch that would multiply the
+    frames matrix past the HBM bound routes to the O(n) path, while the
+    same per-signal shape unbatched keeps the band."""
+    n, m = 1 << 22, 1024  # one signal: ~9x frames fits the 2^27 bound
+    assert ops.select_algorithm(n, m) == "direct"
+    assert ops.select_algorithm(n, m, batch=64) == "overlap_save"
+    assert ops.convolve_initialize(n, m, batch=64).algorithm == \
+        "overlap_save"
+    assert ops.convolve_initialize(n, m).algorithm == "direct"

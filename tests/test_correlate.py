@@ -141,3 +141,14 @@ class TestCrossCorrelate2D:
         got = np.asarray(ops.cross_correlate2D(h, h))
         peak = np.unravel_index(np.argmax(got), got.shape)
         assert peak == (6, 6)
+
+
+def test_correlate_batch_aware_memory_bound():
+    """cross_correlate shares convolve's batch-scaled HBM bound (a
+    review pass found the correlate path still batch-blind after the
+    convolve fix): the same shape that routes batched convolve off the
+    band routes batched correlate too."""
+    n, m = 1 << 22, 1024
+    assert ops.cross_correlate_initialize(n, m).algorithm == "direct"
+    assert ops.cross_correlate_initialize(n, m, batch=64).algorithm == \
+        "overlap_save"

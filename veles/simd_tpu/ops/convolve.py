@@ -109,14 +109,28 @@ def _mxu_frames_elems(x_length: int, h_length: int) -> int:
     return nblk * (F + h_length - 1)
 
 
-def select_algorithm(x_length: int, h_length: int) -> str:
-    """Shape-driven algorithm choice (the convolve_initialize policy)."""
-    band_fits = _mxu_frames_elems(x_length, h_length) <= _DIRECT_MXU_MAX_ELEMS
-    if h_length <= _DIRECT_MAX_H and band_fits:
+def _band_fits(x_length: int, h_length: int, batch: int) -> bool:
+    """The ONE home of the band path's HBM bound (auto-selector and the
+    explicit-direct gate must never desynchronize)."""
+    return (_mxu_frames_elems(x_length, h_length) * max(batch, 1)
+            <= _DIRECT_MXU_MAX_ELEMS)
+
+
+def select_algorithm(x_length: int, h_length: int,
+                     batch: int = 1) -> str:
+    """Shape-driven algorithm choice (the convolve_initialize policy).
+
+    ``batch`` scales the band path's frames-memory bound: the one-shot
+    :func:`convolve` passes its leading-axes product so a (1024, 65536)
+    batch cannot auto-build 1024 frames matrices where one fit; the
+    length-only call (the reference's convolve_initialize shape
+    contract) conservatively assumes batch 1."""
+    fits = _band_fits(x_length, h_length, batch)
+    if h_length <= _DIRECT_MAX_H and fits:
         return "direct"
     if x_length > 2 * h_length and x_length >= _OS_MIN_X:
         return "overlap_save"
-    if h_length <= _DIRECT_MXU_MAX_H and band_fits:
+    if h_length <= _DIRECT_MXU_MAX_H and fits:
         return "direct"  # short-signal mid-size kernels: band still wins
     return "fft"
 
@@ -391,18 +405,21 @@ class ConvolutionHandle:
 def convolve_initialize(x_length: int, h_length: int,
                         algorithm: Optional[str] = None,
                         reverse: bool = False,
-                        impl: Optional[str] = None) -> ConvolutionHandle:
+                        impl: Optional[str] = None,
+                        batch: int = 1) -> ConvolutionHandle:
     """Pick an algorithm for the shapes and build the specialized closure.
 
     ``impl="pallas"`` selects the hand VPU kernel for the direct
     algorithm (pallas/convolve.py). The fft/overlap-save algorithms have
     no Pallas leg by design: their kernel IS the FFT, which XLA owns —
-    see docs/parity.md.
+    see docs/parity.md. ``batch`` (the caller's leading-axes product)
+    feeds the band path's frames-memory bound; the one-shot
+    :func:`convolve` supplies it, direct handle users may.
     """
     if x_length <= 0 or h_length <= 0:
         raise ValueError("x_length and h_length must be positive")
     if algorithm is None:
-        algorithm = select_algorithm(x_length, h_length)
+        algorithm = select_algorithm(x_length, h_length, batch)
     if algorithm not in ALGORITHMS:
         raise ValueError(f"algorithm must be one of {ALGORITHMS}")
     out_length = x_length + h_length - 1
@@ -418,8 +435,7 @@ def convolve_initialize(x_length: int, h_length: int,
             from veles.simd_tpu.pallas.convolve import convolve_direct
             fn = functools.partial(convolve_direct, reverse=reverse)
         elif (h_length <= _DIRECT_MXU_MAX_H
-              and _mxu_frames_elems(x_length, h_length)
-              <= _DIRECT_MXU_MAX_ELEMS):
+              and _band_fits(x_length, h_length, batch)):
             # production direct: the banded-Toeplitz MXU matmul (policy
             # table above; constant compile time, 2-6x the shift-add)
             fn = functools.partial(_convolve_direct_mxu_xla,
@@ -489,8 +505,9 @@ def convolve(x, h, *, mode: str = "full",
         return mode_slice(full, np.shape(x)[-1], np.shape(h)[-1], mode)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
+    batch = int(np.prod(x.shape[:-1], dtype=np.int64)) if x.ndim > 1 else 1
     handle = convolve_initialize(x.shape[-1], h.shape[-1], algorithm,
-                                 impl=impl)
+                                 impl=impl, batch=batch)
     return mode_slice(handle(x, h), x.shape[-1], h.shape[-1], mode)
 
 

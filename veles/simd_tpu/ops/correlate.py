@@ -25,10 +25,10 @@ from veles.simd_tpu.reference import correlate as _ref
 
 def cross_correlate_initialize(x_length: int, h_length: int,
                                algorithm: Optional[str] = None,
-                               impl: Optional[str] = None
-                               ) -> ConvolutionHandle:
+                               impl: Optional[str] = None,
+                               batch: int = 1) -> ConvolutionHandle:
     return convolve_initialize(x_length, h_length, algorithm, reverse=True,
-                               impl=impl)
+                               impl=impl, batch=batch)
 
 
 def cross_correlate_finalize(handle) -> None:
@@ -48,8 +48,9 @@ def cross_correlate(x, h, *, mode: str = "full",
         return mode_slice(full, np.shape(x)[-1], np.shape(h)[-1], mode)
     x = jnp.asarray(x)
     h = jnp.asarray(h)
+    batch = int(np.prod(x.shape[:-1], dtype=np.int64)) if x.ndim > 1 else 1
     handle = cross_correlate_initialize(x.shape[-1], h.shape[-1], algorithm,
-                                        impl=impl)
+                                        impl=impl, batch=batch)
     return mode_slice(handle(x, h), x.shape[-1], h.shape[-1], mode)
 
 
