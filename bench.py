@@ -210,7 +210,22 @@ def _write_full_record(result: dict) -> None:
         with open(path, "w") as f:
             json.dump(rec, f, indent=1)
     except OSError:
-        pass  # read-only checkout: the stdout line still lands
+        return  # read-only checkout: the stdout line still lands
+    # Regenerate the evidence blocks (BASELINE/README/TPU_EVIDENCE) from
+    # the record just written, so a bench run can never leave the repo's
+    # quoted numbers stale — the reference's recompute-at-run-time
+    # property (tests/benchmark.inc:108-113), demanded by VERDICT r4
+    # item 1. Best-effort: a docs problem must never fail a bench run.
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import evidence_table
+        evidence_table.update(write=True)
+    except (Exception, SystemExit) as e:  # noqa - evidence_table raises
+        # SystemExit on missing markers/records; neither may kill the
+        # bench before the driver's one stdout line is printed
+        print(f"evidence_table auto-update skipped: {e}",
+              file=sys.stderr)
 
 
 def bench_matmul_4096():

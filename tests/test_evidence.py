@@ -1,0 +1,60 @@
+"""Evidence-staleness gate (VERDICT r4 item 1).
+
+The reference never hand-copies a performance figure: every number it
+prints is recomputed at run time (/root/reference/tests/benchmark.inc:
+108-113). This repo's equivalent discipline: every current-truth number
+(suite counts, bench headline, the perf table) lives inside generated
+marker blocks rendered from EVIDENCE.json + the newest bench artifact
+by tools/evidence_table.py. Hand-quoted numbers drifted in rounds 2-4
+(VERDICT r4 weak #1-3); this suite makes the default dev loop
+(``pytest tests/``) fail the moment any generated block disagrees with
+a regeneration, so the drift class is structurally dead.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "evidence_table.py")
+
+
+def _run(*flags):
+    return subprocess.run([sys.executable, TOOL, *flags], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_evidence_blocks_current():
+    proc = _run("--check")
+    assert proc.returncode == 0, (
+        "generated evidence blocks are stale — a bench artifact or "
+        "EVIDENCE.json changed without regenerating README/BASELINE/"
+        "TPU_EVIDENCE. Fix: python tools/evidence_table.py --update\n"
+        + proc.stderr)
+
+
+def test_evidence_json_schema():
+    with open(os.path.join(REPO, "EVIDENCE.json")) as f:
+        ev = json.load(f)
+    for key in ("round", "recorded", "cpu_suite", "tpu_suite",
+                "per_file_suites", "tpu_smoke", "dryrun_devices",
+                "skip_reason"):
+        assert key in ev, f"EVIDENCE.json missing {key}"
+    # counts must be recordable even when a suite honestly fails — the
+    # gate checks presence/type, never pass/fail status
+    assert isinstance(ev["cpu_suite"]["failed"], int)
+    assert isinstance(ev["tpu_suite"]["failed"], int)
+
+
+def test_all_marker_targets_carry_blocks():
+    # every default target must still contain its markers — deleting a
+    # marker pair would silently exempt that file from the gate
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import evidence_table as et
+    for name in et.DEFAULT_TARGETS:
+        with open(os.path.join(REPO, name)) as f:
+            text = f.read()
+        has_any = ((et.BEGIN in text and et.END in text)
+                   or (et.SUM_BEGIN in text and et.SUM_END in text))
+        assert has_any, f"{name} lost its evidence markers"

@@ -15,6 +15,11 @@ role is filled with a small AST + text linter over the repo's own rules:
   A3  mutable default argument (list/dict/set literal)
   A4  f-string with no placeholders
   S1  syntax error
+  E1  stale evidence block (full-repo runs only: the generated
+      evidence-table/evidence-summary markers in BASELINE.md /
+      README.md / TPU_EVIDENCE.md disagree with a regeneration from
+      EVIDENCE.json + the newest bench artifact — run
+      ``python tools/evidence_table.py --update``; VERDICT r4 item 1)
 
 Usage:  python tools/lint.py [paths...]     (default: the whole repo)
         --xml  emit cppcheck-style XML (fullcheck_xml analogue)
@@ -165,6 +170,27 @@ def main():
                     f'severity="style" msg="{msg}"/>')
             else:
                 print(f"{rel}:{lineno}: [{code}] {msg}")
+
+    if not args.paths:  # full-repo run: gate evidence freshness too (E1)
+        try:
+            import evidence_table
+            stale = evidence_table.update(write=False)
+            msg = ("stale evidence block - run "
+                   "python tools/evidence_table.py --update")
+        except (Exception, SystemExit) as e:
+            stale = ["EVIDENCE"]
+            msg = f"evidence check unrunnable: {e}"
+        for path in stale:
+            total += 1
+            rel = (os.path.relpath(path, REPO)
+                   if os.path.isabs(str(path)) else str(path))
+            if args.xml:
+                from xml.sax.saxutils import quoteattr
+                xml_rows.append(
+                    f'  <error file={quoteattr(rel)} line="1" id="E1" '
+                    f'severity="style" msg={quoteattr(msg)}/>')
+            else:
+                print(f"{rel}:1: [E1] {msg}")
 
     if args.xml:
         print('<?xml version="1.0"?>\n<results>')
