@@ -389,3 +389,83 @@ def test_selector_batch_aware_memory_bound():
     assert ops.convolve_initialize(n, m, batch=64).algorithm == \
         "overlap_save"
     assert ops.convolve_initialize(n, m).algorithm == "direct"
+
+
+def test_handle_runtime_batch_clamp(monkeypatch):
+    """A band handle built length-only (batch=1, the reference's
+    convolve_initialize shape contract) re-checks the frames HBM bound
+    against the REAL leading-axes product at call time and falls back
+    exactly the way the one-shot path would have selected
+    (VERDICT r4 item 6 / ADVICE r4). Bound shrunk so the test runs at
+    CPU scale; selection logic is identical at the (1024, 65536)
+    production boundary by construction (_band_fits is the one home of
+    the bound)."""
+    import importlib
+
+    C = importlib.import_module("veles.simd_tpu.ops.convolve")
+    n, m = 1 << 16, 127
+    per_signal = C._mxu_frames_elems(n, m)
+    # one signal fits, two do not
+    monkeypatch.setattr(C, "_DIRECT_MXU_MAX_ELEMS", int(per_signal * 1.5))
+    calls = {"band": 0}
+    real_band = C._convolve_direct_mxu_xla
+
+    def counting_band(x, h, reverse=False):
+        calls["band"] += 1
+        return real_band(x, h, reverse=reverse)
+
+    monkeypatch.setattr(C, "_convolve_direct_mxu_xla", counting_band)
+
+    assert C.select_algorithm(n, m) == "direct"
+    assert C.select_algorithm(n, m, batch=2) == "overlap_save"
+    handle = C.convolve_initialize(n, m)  # length-only: assumes batch 1
+    assert handle.algorithm == "direct"
+
+    rng = np.random.default_rng(0)
+    x1 = rng.standard_normal(n).astype(np.float32)
+    xb = rng.standard_normal((2, n)).astype(np.float32)
+    h = rng.standard_normal(m).astype(np.float32)
+
+    got1 = np.asarray(handle(x1, h))
+    assert calls["band"] == 1  # single signal rides the band
+    gotb = np.asarray(handle(xb, h))
+    assert calls["band"] == 1  # batched call re-selected off the band
+    want = np.asarray(ops.convolve(xb, h))  # one-shot path, true batch
+    np.testing.assert_allclose(gotb, want, rtol=0, atol=1e-4)
+    np.testing.assert_allclose(
+        got1, np.asarray(ops.convolve(x1, h, algorithm="direct")),
+        rtol=0, atol=1e-4)
+
+    # explicit algorithm="direct" must stay in the direct family on
+    # fallback (O(n) shift-add), never silently switch to FFT blocks
+    # (the single-signal oracle call above rides the band by design, so
+    # compare against the count as it stands here)
+    before = calls["band"]
+    explicit = C.convolve_initialize(n, m, "direct")
+    got_ex = np.asarray(explicit(xb, h))
+    assert calls["band"] == before
+    np.testing.assert_allclose(got_ex, want, rtol=0, atol=1e-4)
+
+
+def test_explicit_pallas_oversize_warns():
+    """An explicit impl='pallas' direct request past the measured size
+    gate delegates to the XLA band — loudly (ADVICE r4): the caller
+    opted into the hand kernel and must learn they are exercising XLA."""
+    import importlib
+
+    C = importlib.import_module("veles.simd_tpu.ops.convolve")
+    with pytest.warns(UserWarning, match="delegates to the XLA"):
+        h = C.convolve_initialize(C._PALLAS_CONV_MAX_X * 2, 63,
+                                  "direct", impl="pallas")
+    assert h.algorithm == "direct"
+    # the tap-count gate warns too (review r5): the caller must learn
+    # they are exercising XLA whichever gate fired
+    with pytest.warns(UserWarning, match="tap-loop"):
+        C.convolve_initialize(1024, C._DIRECT_UNROLL_MAX_H + 1,
+                              "direct", impl="pallas")
+    # inside the gate: no warning
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        C.convolve_initialize(C._PALLAS_CONV_MAX_X, 63, "direct",
+                              impl="pallas")

@@ -182,10 +182,13 @@ def render_summary(src, rec, ev):
             f"`dryrun_multichip` green at {dry} virtual devices; {head}.")
     drift = rec.get("drift_anchor")
     if isinstance(drift, dict) and drift.get("gflops") is not None:
+        raw = drift.get("raw_gflops")
         body += (f" Chip-state drift anchor: {drift['gflops']:,.0f} GFLOPS"
-                 " on the canonical 1024-cubed chain (utils/benchlib.py"
-                 " drift_anchor; compare across artifacts before trusting"
-                 " cross-session ratios).")
+                 + (f" corrected / {raw:,.0f} raw" if raw is not None
+                    else " corrected")
+                 + " on the canonical matmul chain (bench.py"
+                 " bench_drift_anchor; divide rates by their session's"
+                 " anchor before trusting cross-session ratios).")
     return "\n".join([
         SUM_BEGIN,
         "*(generated by `python tools/evidence_table.py --update` from"
@@ -195,7 +198,13 @@ def render_summary(src, rec, ev):
 
 
 def splice(path, blocks):
-    """Replace every marker pair present in *path* with its block."""
+    """Replace every marker pair present in *path* with its block.
+
+    ``blocks`` is a list of ``(begin_marker, end_marker, block)``; a
+    bare block string is accepted for the original one-table call
+    shape (tests/test_evidence_table.py pins it)."""
+    if isinstance(blocks, str):
+        blocks = [(BEGIN, END, blocks)]
     with open(path) as f:
         text = f.read()
     found = False

@@ -36,6 +36,7 @@ it are recorded at the policy block below.
 from __future__ import annotations
 
 import functools
+import warnings
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -418,15 +419,30 @@ def convolve_initialize(x_length: int, h_length: int,
     """
     if x_length <= 0 or h_length <= 0:
         raise ValueError("x_length and h_length must be positive")
+    auto_selected = algorithm is None
     if algorithm is None:
         algorithm = select_algorithm(x_length, h_length, batch)
     if algorithm not in ALGORITHMS:
         raise ValueError(f"algorithm must be one of {ALGORITHMS}")
     out_length = x_length + h_length - 1
     if algorithm == "direct":
-        if (resolve_impl(impl) == "pallas"
-                and h_length <= _DIRECT_UNROLL_MAX_H
-                and x_length <= _PALLAS_CONV_MAX_X):
+        pallas_ok = (h_length <= _DIRECT_UNROLL_MAX_H
+                     and x_length <= _PALLAS_CONV_MAX_X)
+        if resolve_impl(impl) == "pallas" and not pallas_ok:
+            # an explicit pallas opt-in past either gate would silently
+            # measure/exercise XLA (ADVICE r4) — keep the delegation
+            # (the band IS the production path there) but say so at
+            # build time, naming the gate that fired
+            gate = (f"x_length <= {_PALLAS_CONV_MAX_X} (grid-overhead "
+                    f"bound, measured waiver in pallas/convolve.py)"
+                    if h_length <= _DIRECT_UNROLL_MAX_H else
+                    f"h_length <= {_DIRECT_UNROLL_MAX_H} (the kernel's "
+                    f"tap-loop trace/VMEM ceiling)")
+            warnings.warn(
+                f"impl='pallas' direct convolution is size-gated to "
+                f"{gate}; shape ({x_length}, {h_length}) delegates to "
+                f"the XLA path", stacklevel=2)
+        if resolve_impl(impl) == "pallas" and pallas_ok:
             # same unroll ceiling as the VPU shift-add (the kernel's tap
             # loop is linear in h at trace time), plus the r4 measured
             # size gate: past _PALLAS_CONV_MAX_X the kernel's VMEM
@@ -437,9 +453,32 @@ def convolve_initialize(x_length: int, h_length: int,
         elif (h_length <= _DIRECT_MXU_MAX_H
               and _band_fits(x_length, h_length, batch)):
             # production direct: the banded-Toeplitz MXU matmul (policy
-            # table above; constant compile time, 2-6x the shift-add)
-            fn = functools.partial(_convolve_direct_mxu_xla,
-                                   reverse=reverse)
+            # table above; constant compile time, 2-6x the shift-add).
+            # The build-time bound used the caller's declared batch; the
+            # closure re-checks against the REAL leading-axes product at
+            # call time, so a handle built length-only (batch=1, the
+            # reference's shape contract) invoked on a (1024, ...) batch
+            # cannot auto-build frames ~9x past the HBM bound
+            # (VERDICT r4 item 6 / ADVICE r4). Auto-selected handles
+            # re-select with the true batch (matching the one-shot
+            # path); explicit algorithm="direct" stays in the direct
+            # family via the O(n)-memory shift-add/conv fallback.
+            band = functools.partial(_convolve_direct_mxu_xla,
+                                     reverse=reverse)
+            fb_cache = {}  # rb -> fallback handle (stable per shape)
+
+            def fn(x, h, _band=band, _auto=auto_selected):
+                rb = (int(np.prod(x.shape[:-1], dtype=np.int64))
+                      if getattr(x, "ndim", 1) > 1 else 1)
+                if _band_fits(x_length, h_length, rb):
+                    return _band(x, h)
+                if _auto:  # terminates: with !fits the band can't re-win
+                    if rb not in fb_cache:
+                        fb_cache[rb] = convolve_initialize(
+                            x_length, h_length, None, reverse=reverse,
+                            impl=impl, batch=rb)
+                    return fb_cache[rb](x, h)
+                return _convolve_direct_xla(x, h, reverse=reverse)
         else:
             # oversized explicit-direct: the band's frames matrix would
             # cost ~(h/128)x the signal in HBM; _convolve_direct_xla is

@@ -497,36 +497,57 @@ def _zpk_to_sos(z, p, k):
 
     Order-equivalence, not scipy-bit-equality: any pairing yields the
     same cascade product (tests compare responses, and sosfilt feeds
-    sections identically). Sections are ordered by pole distance from
-    the unit circle, farthest first, so the most resonant section runs
-    last over the already-shaped signal (the usual overflow discipline);
-    the overall gain lands on the first section's numerator."""
+    sections identically). Numerator sections are matched to the pole
+    section whose poles they sit closest to — scipy's zpk2sos
+    discipline, most-resonant poles claiming their nearest zeros first —
+    which keeps each section's intermediate gain flat where an
+    arbitrary pairing can square the f32 dynamic range for high-order
+    narrow-band designs (ADVICE r4). Sections are then ordered by pole
+    distance from the unit circle, farthest first, so the most resonant
+    section runs last over the already-shaped signal (the usual
+    overflow discipline); the overall gain lands on the first section's
+    numerator."""
     zp, zr = _split_conjugates(z)
     pp, pr = _split_conjugates(p)
 
     def quads(pairs, reals):
-        out = [(np.array([1.0, -2 * r.real, abs(r) ** 2]), abs(abs(r) - 1))
-               for r in pairs]
+        # (coeffs, unit-circle distance, representative root)
+        out = [(np.array([1.0, -2 * r.real, abs(r) ** 2]),
+                abs(abs(r) - 1), complex(r)) for r in pairs]
         reals = list(reals)
         while len(reals) >= 2:
             r1, r2 = reals.pop(), reals.pop()
             out.append((np.array([1.0, -(r1 + r2), r1 * r2]),
-                        abs(abs(r1) - 1)))
+                        abs(abs(r1) - 1), complex(r1)))
         if reals:
             r = reals.pop()
-            out.append((np.array([1.0, -r, 0.0]), abs(abs(r) - 1)))
+            out.append((np.array([1.0, -r, 0.0]), abs(abs(r) - 1),
+                        complex(r)))
         return out
 
     num = quads(zp, zr)
     den = quads(pp, pr)
     if len(num) > len(den):
         raise ValueError("more zero sections than pole sections")
-    num += [(np.array([1.0, 0.0, 0.0]), 0.0)] * (len(den) - len(num))
+    # nearest-zero-to-pole assignment: most resonant pole section first
+    # (it needs its shaping zeros most), each claiming the unused
+    # numerator whose representative zero is closest to its pole
+    identity = (np.array([1.0, 0.0, 0.0]), 0.0, 0j)
+    claim_order = np.argsort([d[1] for d in den])
+    unused = list(range(len(num)))
+    matched = [identity] * len(den)
+    for di in claim_order:
+        if not unused:
+            break
+        pole = den[di][2]
+        j = min(unused, key=lambda i: abs(num[i][2] - pole))
+        matched[di] = num[j]
+        unused.remove(j)
     # most-resonant pole section (closest to the unit circle) last
     order = np.argsort([-d[1] for d in den])
     sos = np.zeros((len(den), 6), np.float64)
     for row, idx in enumerate(order):
-        sos[row, :3] = num[idx][0]
+        sos[row, :3] = matched[idx][0]
         sos[row, 3:] = den[idx][0]
     sos[0, :3] *= k
     return sos
