@@ -85,22 +85,25 @@ def test_convolve_commutative(rng):
 
 def test_selector_contract():
     # Structure parity with convolve_initialize (convolve.c:328-366),
-    # constants from the r4 on-chip sweep (policy table in
-    # ops/convolve.py): the banded-Toeplitz MXU direct path beats the
-    # block FFT up to h=1024 at any signal length; longer kernels on
-    # long signals take overlap_save (O(n) memory, within 2x); short
-    # signals with mid-size kernels stay on the band; only kernels past
-    # the explicit-direct band cap on short signals take fft.
+    # constants from the r4 on-chip sweep plus the r5 stripe retune
+    # (policy table in ops/convolve.py): the banded-Toeplitz MXU direct
+    # path beats the block FFT up to h=2048 at any signal length (r5:
+    # frame width now scales with h, so the F=256 band outran
+    # overlap-save on every reliable m=2047 row); longer kernels on
+    # long signals take overlap_save (O(n) memory); short signals with
+    # mid-size kernels stay on the band; only kernels past the
+    # explicit-direct band cap on short signals take fft.
     assert ops.select_algorithm(65536, 127) == "direct"
     assert ops.select_algorithm(65536, 255) == "direct"
-    assert ops.select_algorithm(65536, 1024) == "direct"
-    assert ops.select_algorithm(65536, 1025) == "overlap_save"
+    assert ops.select_algorithm(65536, 2048) == "direct"
+    assert ops.select_algorithm(65536, 2049) == "overlap_save"
     assert ops.select_algorithm(64, 16) == "direct"
-    assert ops.convolve_initialize(65536, 2048).algorithm == "overlap_save"
+    assert ops.convolve_initialize(65536, 4096).algorithm == "overlap_save"
     assert ops.convolve_initialize(64, 16).algorithm == "direct"
-    # block FFT needs x > 2h and >= 2 blocks; met here
-    assert ops.select_algorithm(16384, 2048) == "overlap_save"
-    assert ops.select_algorithm(32768, 2048) == "overlap_save"
+    # block FFT needs x > 2h and >= 2 blocks; met here (h past the r5
+    # band range — h=2048 itself now stays on the band at any x)
+    assert ops.select_algorithm(16384, 4096) == "overlap_save"
+    assert ops.select_algorithm(32768, 4096) == "overlap_save"
     # below the overlap-save signal floor the band keeps mid kernels
     assert ops.select_algorithm(8192, 2048) == "direct"
     # balanced big shapes: band up to its explicit cap, fft beyond
@@ -469,3 +472,34 @@ def test_explicit_pallas_oversize_warns():
         _w.simplefilter("error")
         C.convolve_initialize(C._PALLAS_CONV_MAX_X, 63, "direct",
                               impl="pallas")
+
+
+def test_explicit_direct_oversize_batch_slices_band(monkeypatch):
+    """An explicit-direct band handle fed a batch past the HBM bound
+    must slice the batch through the band (r5 review finding), never
+    fall to the degenerate-conv lowering whose compile is superlinear
+    in x. Bound shrunk so every row becomes its own slice at CPU
+    scale."""
+    import importlib
+
+    C = importlib.import_module("veles.simd_tpu.ops.convolve")
+    n, m = 4096, 600  # m > _DIRECT_UNROLL_MAX_H: shift-add unavailable
+    per_signal = C._mxu_frames_elems(n, m)
+    monkeypatch.setattr(C, "_DIRECT_MXU_MAX_ELEMS", int(per_signal * 1.5))
+    degenerate_called = {"n": 0}
+    real_direct = C._convolve_direct_xla
+
+    def counting_direct(x, h, reverse=False):
+        degenerate_called["n"] += 1
+        return real_direct(x, h, reverse=reverse)
+
+    monkeypatch.setattr(C, "_convolve_direct_xla", counting_direct)
+    handle = C.convolve_initialize(n, m, "direct")  # band fits batch=1
+    rng = np.random.default_rng(5)
+    xb = rng.standard_normal((3, n)).astype(np.float32)
+    h = rng.standard_normal(m).astype(np.float32)
+    got = np.asarray(handle(xb, h))
+    assert degenerate_called["n"] == 0
+    want = np.asarray(ops.convolve(xb, h, algorithm="fft"))
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(got / scale, want / scale, atol=2e-6)

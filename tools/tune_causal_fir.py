@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""causal_fir band candidacy IN CONTEXT (VERDICT r4 item 7).
+
+Isolated m~31 measurements showed band ~ parity with the shift-add, and
+the stream step is latency-bound — so r4 left causal_fir on the VPU
+shift-add. This measures the swap inside the two real consumers:
+
+  flagship  SignalPipeline (normalize -> FIR -> SWT -> MXU head) at the
+            bench shape (128, 4096), fir m=31
+  stream    the batched FIR->SWT serving step at (256, 4096)
+
+Legs: production causal_fir (shift-add) vs the banded-Toeplitz MXU form
+(full band conv sliced to the causal n) substituted at the FIR stage.
+
+Run:  python tools/tune_causal_fir.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import importlib
+
+    from veles.simd_tpu import models, ops
+    # module-object import: the ops package re-exports `convolve` the
+    # FUNCTION under the same name (see ops/correlate.py's warning)
+    C = importlib.import_module("veles.simd_tpu.ops.convolve")
+    S = importlib.import_module("veles.simd_tpu.ops.stream")
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    rng = np.random.default_rng(0)
+    decay = jnp.float32(0.999)
+    m = 31
+    fir = jnp.asarray(np.hanning(m).astype(np.float32))
+
+    def band_causal(x, h):
+        return C._convolve_direct_mxu_xla(x, h)[..., : x.shape[-1]]
+
+    # correctness of the substitute
+    xs = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+    err = float(jnp.abs(band_causal(xs, fir)
+                        - ops.causal_fir(xs, fir)).max())
+    print(f"band-causal vs shift-add max err: {err:.2e}")
+
+    # ---- flagship pipeline ----
+    B, n, K = 128, 4096, 16
+    x = jnp.asarray(rng.normal(size=(B, n)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(3 * n, K)).astype(np.float32)
+                    / np.sqrt(3 * n))
+    pipe = models.SignalPipeline()
+
+    prod_fir = ops.causal_fir
+
+    def flagship(c, use_band):
+        # substitute at the module seam the pipeline calls through
+        ops.causal_fir = band_causal if use_band else prod_fir
+        try:
+            out = pipe(c, fir, w)
+        finally:
+            ops.causal_fir = prod_fir
+        return c * decay + jnp.float32(1e-6) * out.sum()
+
+    # trace-time substitution: build two jitted closures up front
+    flag_prod = jax.jit(lambda c: flagship(c, False))
+    flag_band = jax.jit(lambda c: flagship(c, True))
+
+    # ---- stream step (the bench_stream composition: FIR(32)->SWT) ----
+    Bs, chunk = 256, 4096
+    h32 = jnp.asarray(rng.normal(size=32).astype(np.float32) / 32)
+    x0 = jnp.asarray(rng.normal(size=(Bs, chunk)).astype(np.float32))
+    fir0 = ops.fir_stream_init(h32, batch_shape=(Bs,))
+    swt0 = ops.swt_stream_init(8, 1, batch_shape=(Bs,))
+
+    def stream_leg(c, use_band):
+        fir_tail, swt_tail, xx = c
+        saved = S.causal_fir
+        if use_band:
+            S.causal_fir = band_causal
+        try:
+            fs, y = ops.fir_stream_step(ops.FirStreamState(fir_tail),
+                                        xx, h32)
+        finally:
+            S.causal_fir = saved
+        ss, (hi, lo) = ops.swt_stream_step(
+            ops.SwtStreamState(swt_tail), y, "daubechies", 8, 1)
+        return (fs.tail, ss.tail, xx + jnp.float32(1e-6) * (hi + lo))
+
+    stream_prod = jax.jit(lambda c: stream_leg(c, False))
+    stream_band = jax.jit(lambda c: stream_leg(c, True))
+    xs2 = (fir0.tail, swt0.tail, x0)
+
+    for label, carry, legs, samples in (
+            ("flagship(128,4096)", x,
+             {"shift_add": flag_prod, "mxu_band": flag_band}, B * n),
+            ("stream(256,4096)", xs2,
+             {"shift_add": stream_prod, "mxu_band": stream_band},
+             Bs * chunk)):
+        sts = chain_stats(legs, carry, 512, reps=3, on_floor="nan",
+                          null_carry=carry[:1, :8], attempts=2,
+                          attempt_gap_s=2.0)
+        msg = label
+        for name, st in sts.items():
+            sec, raw = st.get("sec"), st.get("raw_sec")
+            msps = (samples / 1e6 / sec
+                    if sec and np.isfinite(sec) else float("nan"))
+            rmsps = (samples / 1e6 / raw
+                     if raw and np.isfinite(raw) else float("nan"))
+            e = f" ERR:{st['error'][:50]}" if st.get("error") else ""
+            msg += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
+        print(msg, flush=True)
+
+
+if __name__ == "__main__":
+    main()

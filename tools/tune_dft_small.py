@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Measure the MXU-DFT trick against the FFT paths for czt/zoom_fft and
+cwt (VERDICT r4 item 4: the r4 _frame_power DFT-matmul rewrite won 3.5x
+on Welch at nfft <= 2048 — does the same trick carry to Bluestein's
+convolution at small m and the cwt scale-bank multiply?).
+
+czt candidate: X[k] = sum_n x[n] a^-n w^(nk) evaluated as one dense
+(n, m) chirp matmul — four real MXU matmuls (re/im x re/im) instead of
+the fft/ifft pair over the L = next_pow2(n+m-1) Bluestein buffer. The
+chirp matrix is host-built f64 (mod-2pi phases) like the Bluestein
+constants, shipped as two f32 (n, m) panes.
+
+cwt candidate: replace the length-L rfft/irfft pair with DFT matmuls
+(cos/sin panes) at small L; the scale axis stays in the batch rows.
+
+Run:  python tools/tune_dft_small.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def chirp_matrix(n, m, w, a):
+    """(n, m) f64 chirp matrix Z[j, k] = a^-j w^(jk), phases mod 2pi."""
+    j = np.arange(n, dtype=np.float64)[:, None]
+    k = np.arange(m, dtype=np.float64)[None, :]
+    argw, arga = np.angle(w), np.angle(a)
+    logw, loga = np.log(np.abs(w)), np.log(np.abs(a))
+    phase = np.mod(j * k * argw - j * arga, 2 * np.pi)
+    mag = np.exp(j * k * logw - j * loga)
+    return mag * np.exp(1j * phase)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import ops
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    P = jax.lax.Precision.HIGHEST
+    rng = np.random.default_rng(0)
+    decay = jnp.float32(0.999)
+
+    # ---------------- czt / zoom_fft ----------------
+    # the axon tunnel rejects constant uploads past ~100 MB per request
+    # (HTTP 413 at a 256 MB chirp pane) — (n, m) stays under ~32M elems,
+    # which is also where the direct matrix stops being HBM-sane
+    for (B, n, m) in [(64, 16384, 512), (64, 4096, 512),
+                      (256, 4096, 256), (16, 32768, 512)]:
+        x = jnp.asarray(rng.normal(size=(B, n)).astype(np.float32))
+        w = np.exp(-2j * np.pi * 0.1 / m)  # a zoom band step
+        a = np.exp(2j * np.pi * 0.05)
+        Z = chirp_matrix(n, m, w, a)
+        Zre = jnp.asarray(Z.real, jnp.float32)
+        Zim = jnp.asarray(Z.imag, jnp.float32)
+
+        @jax.jit
+        def direct(c, Zre=Zre, Zim=Zim):
+            re = jnp.matmul(c, Zre, precision=P)
+            im = jnp.matmul(c, Zim, precision=P)
+            return re + im  # fold for the chain checksum
+
+        def fft_leg(c, w=w, a=a, m=m):
+            y = ops.czt(c, m, w, a)
+            return jnp.real(y) + jnp.imag(y)
+
+        # correctness of the direct form vs the czt path
+        got = np.asarray(direct(x))
+        want = np.asarray(fft_leg(x))
+        err = np.abs(got - want).max() / max(1.0, np.abs(want).max())
+
+        # chain via a scalar fold so the carry keeps the (B, n) shape
+        def dstep(c, f=direct):
+            return c * decay + jnp.float32(1e-6) * f(c).sum()
+
+        def fstep(c, f=fft_leg):
+            return c * decay + jnp.float32(1e-6) * f(c).sum()
+
+        sts = chain_stats({"direct_mm": dstep, "bluestein": fstep},
+                          x, 256, reps=3, on_floor="nan",
+                          null_carry=x[:1, :8], attempts=2,
+                          attempt_gap_s=2.0)
+        ms = B * n / 1e6
+        line = f"czt B={B} n={n} m={m} relerr={err:.1e}"
+        for name, st in sts.items():
+            sec = st.get("sec")
+            msps = ms / sec if sec and np.isfinite(sec) else float("nan")
+            raw = st.get("raw_sec")
+            rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
+            e = f" ERR:{st['error'][:60]}" if st.get("error") else ""
+            line += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
+        print(line, flush=True)
+
+    # ---------------- cwt ----------------
+    for (B, n, S) in [(16, 1024, 32), (16, 2048, 32), (4, 8192, 32),
+                      (64, 512, 16)]:
+        x = jnp.asarray(rng.normal(size=(B, n)).astype(np.float32))
+        scales = np.geomspace(1, n / 16, S)
+
+        def fft_leg(c, scales=scales):
+            y = ops.cwt(c, scales, "ricker")
+            return c * decay + jnp.float32(1e-6) * y.sum()
+
+        # DFT-matmul variant: same bank, rfft/irfft as cos/sin matmuls
+        from veles.simd_tpu.ops.cwt import _bank_fft
+        bank_re, bank_im, L, is_cx = _bank_fft("ricker", tuple(scales),
+                                               n, 5.0, False)
+        kf = np.arange(L // 2 + 1)
+        t = np.arange(L)
+        ang = 2 * np.pi * np.outer(t, kf) / L
+        Cm = jnp.asarray(np.cos(ang), jnp.float32)          # (L, K)
+        Sm = jnp.asarray(np.sin(ang), jnp.float32)
+        # irfft weights: x[t] = (1/L) sum_k w_k (Re X cos - Im X sin)
+        wk = np.full(L // 2 + 1, 2.0)
+        wk[0] = 1.0
+        if L % 2 == 0:
+            wk[-1] = 1.0
+        CmT = jnp.asarray((np.cos(ang) * wk / L).T, jnp.float32)  # (K, L)
+        SmT = jnp.asarray((np.sin(ang) * wk / L).T, jnp.float32)
+        bre = jnp.asarray(bank_re)
+        bim = jnp.asarray(bank_im)
+
+        @jax.jit
+        def dft_leg(c, Cm=Cm, Sm=Sm, CmT=CmT, SmT=SmT, bre=bre,
+                    bim=bim, L=L, n=n):
+            pad = jnp.pad(c, ((0, 0), (0, L - n)))
+            Xre = jnp.matmul(pad, Cm, precision=P)     # (B, K)
+            Xim = -jnp.matmul(pad, Sm, precision=P)
+            # multiply by the (S, K) bank spectrum -> (B, S, K)
+            Yre = Xre[:, None, :] * bre - Xim[:, None, :] * bim
+            Yim = Xre[:, None, :] * bim + Xim[:, None, :] * bre
+            y = (jnp.matmul(Yre, CmT, precision=P)
+                 - jnp.matmul(Yim, SmT, precision=P))[..., :n]
+            return c * decay + jnp.float32(1e-6) * y.sum()
+
+        # correctness
+        yw = np.asarray(ops.cwt(x, scales, "ricker"))
+        pad = jnp.pad(x, ((0, 0), (0, L - n)))
+        Xre = jnp.matmul(pad, Cm, precision=P)
+        Xim = -jnp.matmul(pad, Sm, precision=P)
+        Yre = Xre[:, None, :] * bre - Xim[:, None, :] * bim
+        Yim = Xre[:, None, :] * bim + Xim[:, None, :] * bre
+        yd = np.asarray((jnp.matmul(Yre, CmT, precision=P)
+                         - jnp.matmul(Yim, SmT, precision=P))[..., :n])
+        err = np.abs(yd - yw).max() / max(1.0, np.abs(yw).max())
+
+        sts = chain_stats({"dft_mm": dft_leg, "fft": fft_leg},
+                          x, 256, reps=3, on_floor="nan",
+                          null_carry=x[:1, :8], attempts=2,
+                          attempt_gap_s=2.0)
+        ms = B * n * S / 1e6  # scale-bank output samples
+        line = f"cwt B={B} n={n} S={S} L={L} relerr={err:.1e}"
+        for name, st in sts.items():
+            sec = st.get("sec")
+            msps = ms / sec if sec and np.isfinite(sec) else float("nan")
+            raw = st.get("raw_sec")
+            rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
+            e = f" ERR:{st['error'][:60]}" if st.get("error") else ""
+            line += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

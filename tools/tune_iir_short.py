@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Profile the short-signal sosfilt floor and its candidates
+(VERDICT r4 item 3: the (256, 4096) butter-6 cascade is the slowest
+compute row with no ceiling statement).
+
+Candidates measured on-chip against the production flat-tree cascade
+(ops/iir.py::_sosfilt_xla, the lax.scan-over-sections form):
+
+  cascade   production path (3 sections x 2-plane associative tree)
+  unrolled  same math, Python loop over sections (fusion opportunity:
+            y_k -> u_{k+1} build without the scan carry boundary)
+  joint6    ONE tree over the cascade's joint 6-dim state space --
+            block-lower-triangular A built from the sos rows, A-products
+            on (n, 6, 6) tiny planes, u as six flat (n, B) planes
+  components: u-build alone, one 2-plane tree alone -- the additive
+            floor the cascade could at best reach
+
+Run:  python tools/tune_iir_short.py [batch n]
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def joint_state_space(sos):
+    """Joint (A, Bv, C, D) of the biquad cascade, from (S, 6) sos rows.
+
+    Transposed direct form II per section: s_k[t] = T_k s_k[t-1]
+    + g_k x_k[t], y_k[t] = b0_k x_k[t] + e1 . s_k[t-1], cascaded
+    x_{k+1} = y_k. All entries polynomial in the coefficients, so this
+    traces (sos stays a runtime array). NumPy f64 here for the
+    experiment; a production port would build it in the jit.
+    """
+    S = sos.shape[0]
+    A = np.zeros((2 * S, 2 * S))
+    Bv = np.zeros(2 * S)
+    C = np.zeros(2 * S)
+    # x_k[t] = pre_k * x[t] + sum_j coup_k[j] . s_j[t-1]
+    pre = 1.0
+    coup = np.zeros(2 * S)
+    for k in range(S):
+        b0, b1, b2, _, a1, a2 = sos[k]
+        T = np.array([[-a1, 1.0], [-a2, 0.0]])
+        g = np.array([b1 - a1 * b0, b2 - a2 * b0])
+        rows = slice(2 * k, 2 * k + 2)
+        A[rows, rows] = T
+        A[rows, :] += np.outer(g, coup)
+        Bv[rows] = g * pre
+        # next section's input: y_k = b0 x_k + s_k[0]
+        coup = b0 * coup
+        coup[2 * k] += 1.0
+        pre = b0 * pre
+    C[:] = coup
+    D = pre
+    return A, Bv, C, D
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import ops
+    from veles.simd_tpu.ops import iir as I
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+    sos_np = np.asarray(ops.butter_sos(6, 0.2), np.float64)
+    sos = jnp.asarray(sos_np, jnp.float32)
+    S = sos_np.shape[0]
+    A, Bv, C, D = joint_state_space(sos_np)
+    Aj = jnp.asarray(A, jnp.float32)
+    Bj = jnp.asarray(Bv, jnp.float32)
+    Cj = jnp.asarray(C, jnp.float32)
+    Dj = jnp.float32(D)
+
+    decay = jnp.float32(0.999)
+
+    def cascade(c):
+        return ops.sosfilt(c, sos, impl="xla") * decay
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n_sections",))
+    def _unrolled(xx, ss, n_sections):
+        lead, nn = xx.shape[:-1], xx.shape[-1]
+        b = int(np.prod(lead)) if lead else 1
+        yT = xx.reshape(b, nn).T
+        z = jnp.zeros((b,), jnp.float32)
+        for k in range(n_sections):
+            cf = (ss[k, 0], ss[k, 1], ss[k, 2], ss[k, 4], ss[k, 5])
+            yT, _, _ = I._section_scan_T(yT, cf, z, z)
+        return yT.T.reshape(lead + (nn,))
+
+    def unrolled(c):
+        return _unrolled(c, sos, S) * decay
+
+    @jax.jit
+    def _joint6(xx, Am, Bm, Cm, Dm):
+        lead, nn = xx.shape[:-1], xx.shape[-1]
+        b = int(np.prod(lead)) if lead else 1
+        xT = xx.reshape(b, nn).T                      # (n, B)
+        d = Am.shape[0]
+        u = [Bm[i] * xT for i in range(d)]            # six (n, B) planes
+        Ap = jnp.broadcast_to(Am, (nn, d, d))         # (n, 6, 6) tiny
+
+        def combine(left, right):
+            lA, lu = left
+            rA, ru = right
+            # A-product on tiny planes; u-mix as flat-plane FMAs
+            nA = jnp.einsum("tij,tjk->tik", rA, lA)
+            nu = [ru[i] + sum(rA[:, i, j, None] * lu[j]
+                              for j in range(d))
+                  for i in range(d)]
+            return nA, tuple(nu)
+
+        Ac, s = jax.lax.associative_scan(combine, (Ap, tuple(u)), axis=0)
+        # y[t] = D x[t] + C . s[t-1]
+        sprev = [jnp.concatenate([jnp.zeros((1, b), jnp.float32),
+                                  s[i][:-1]]) for i in range(d)]
+        yT = Dm * xT + sum(Cm[i] * sprev[i] for i in range(d))
+        return yT.T.reshape(lead + (nn,))
+
+    def joint6(c):
+        return _joint6(c, Aj, Bj, Cj, Dj) * decay
+
+    # components: the additive floor
+    @jax.jit
+    def _ubuild(xx):
+        lead, nn = xx.shape[:-1], xx.shape[-1]
+        b = int(np.prod(lead)) if lead else 1
+        xT = xx.reshape(b, nn).T
+        u1 = jnp.float32(0.3) * xT
+        u2 = jnp.float32(0.2) * xT
+        return (u1 + u2).T.reshape(lead + (nn,))
+
+    def ubuild(c):
+        return _ubuild(c) * decay
+
+    @jax.jit
+    def _tree2(xx):
+        lead, nn = xx.shape[:-1], xx.shape[-1]
+        b = int(np.prod(lead)) if lead else 1
+        xT = xx.reshape(b, nn).T
+        cf = (jnp.float32(0.5), jnp.float32(0.1), jnp.float32(0.05),
+              jnp.float32(-0.4), jnp.float32(0.1))
+        z = jnp.zeros((b,), jnp.float32)
+        yT, _, _ = I._section_scan_T(xT, cf, z, z)
+        return yT.T.reshape(lead + (nn,))
+
+    def tree2(c):
+        return _tree2(c) * decay
+
+    # correctness first (vs the f64 oracle)
+    want = np.asarray(I._ref.sosfilt(np.asarray(x, np.float64), sos_np))
+    for name, fn in [("cascade", lambda c: ops.sosfilt(c, sos,
+                                                       impl="xla")),
+                     ("unrolled", lambda c: _unrolled(c, sos, S)),
+                     ("joint6", lambda c: _joint6(c, Aj, Bj, Cj, Dj))]:
+        got = np.asarray(fn(x))
+        err = np.abs(got - want).max() / max(1.0, np.abs(want).max())
+        print(f"{name:9s} relerr vs f64 oracle: {err:.3e}")
+
+    steps = {"cascade": cascade, "unrolled": unrolled, "joint6": joint6,
+             "ubuild": ubuild, "tree2": tree2}
+    sts = chain_stats(steps, x, 512, reps=3, on_floor="nan",
+                      null_carry=x[:1, :8], attempts=2,
+                      attempt_gap_s=2.0)
+    ms = batch * n / 1e6
+    for name, st in sts.items():
+        sec, raw = st.get("sec"), st.get("raw_sec")
+        msps = ms / sec if sec and np.isfinite(sec) else float("nan")
+        rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
+        err = f"  ERROR {st['error']}" if st.get("error") else ""
+        print(f"{name:9s} corrected {msps:8.0f} MS/s   raw {rmsps:8.0f} "
+              f"MS/s{err}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Retune the h in [1024, 8192] convolution stripe (VERDICT r4 item 5).
+
+The r4 auto-selector hands h > 1024 to overlap-save because the MXU
+band's frames matrix at F=128 expands HBM by ~(1 + (m-1)/F)x — ~9x at
+m=1023, ~33x at m=4095. But F=128 was tuned at m=127: scaling the frame
+width with the kernel keeps the compute overhead (F+m-1)/m bounded
+while collapsing the HBM expansion to (F+m-1)/F ~ 2x, which both speeds
+the band up in this stripe and un-binds the memory gate that forced the
+OS handoff. This sweep measures, per (n, m):
+
+  band_F{F}   the banded-Toeplitz matmul at frame width F
+  os_L{L}     overlap-save at FFT block L (the r3-tuned floor was 8192)
+  fft         one full-length FFT pair
+
+Run:  python tools/tune_os_stripe.py [quick]
+"""
+
+import functools
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops.convolve import (_convolve_overlap_save_xla,
+                                             _convolve_fft_xla)
+    from veles.simd_tpu.shapes import fft_convolution_length
+    from veles.simd_tpu.utils.benchlib import chain_stats
+
+    @functools.partial(jax.jit, static_argnames=("F",))
+    def band_F(x, h, F):
+        """_convolve_direct_mxu_xla with a parameterized frame width."""
+        x = jnp.asarray(x, jnp.float32)
+        h = jnp.asarray(h, jnp.float32)[::-1]
+        n, m = x.shape[-1], h.shape[-1]
+        K = F + m - 1
+        out_len = n + m - 1
+        nblk = -(-out_len // F)
+        extra = -(-(m - 1) // F)
+        lead = x.shape[:-1]
+        xp = jnp.pad(x, [(0, 0)] * (x.ndim - 1)
+                     + [(m - 1, (nblk + extra) * F - n - (m - 1))])
+        shifts = [xp[..., j * F:(nblk + j) * F].reshape(lead + (nblk, F))
+                  for j in range(extra + 1)]
+        frames = (jnp.concatenate(shifts, axis=-1)[..., :K]
+                  if extra else shifts[0])
+        v = jnp.concatenate([h, jnp.zeros(F, jnp.float32)])
+        S = jnp.tile(v, F)[:F * K].reshape(F, K)
+        out = jax.lax.dot_general(
+            frames, S, (((frames.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        return out.reshape(lead + (nblk * F,))[..., :out_len]
+
+    rng = np.random.default_rng(0)
+    decay = jnp.float32(0.999)
+    quick = len(sys.argv) > 1 and sys.argv[1] == "quick"
+
+    shapes = [(1, 65536, 1023), (1, 65536, 2047), (1, 65536, 4095),
+              (1, 65536, 8191), (1, 1 << 20, 2047), (1, 1 << 20, 8191),
+              (64, 16384, 2047)]
+    if quick:
+        shapes = shapes[:2]
+
+    for (B, n, m) in shapes:
+        x0 = rng.normal(size=(B, n)).astype(np.float32)
+        x = jnp.asarray(x0[0] if B == 1 else x0)
+        hh = jnp.asarray(rng.normal(size=m).astype(np.float32))
+        out_len = n + m - 1
+        steps = {}
+
+        def _chain(fn):
+            def step(c, fn=fn):
+                # renormalize: a random m-tap kernel amplifies ~sqrt(m)x
+                # per step, overflowing f32 within ~20 chain iterations
+                y = fn(c, hh)[..., :c.shape[-1]]
+                return y * jax.lax.rsqrt(jnp.mean(y * y)
+                                         + jnp.float32(1e-30))
+            return step
+
+        for F in (128, 256, 512, 1024, 2048):
+            if F > 4 * m:
+                continue
+            frames_elems = (-(-out_len // F)) * (F + m - 1) * B
+            if frames_elems > (1 << 28):
+                continue  # past even a relaxed HBM bound
+            steps[f"band_F{F}"] = _chain(
+                functools.partial(band_F, F=F))
+        for L in (8192, 16384, 32768, 65536, 131072):
+            if L < 2 * (m - 1) or L > 2 * n:
+                continue
+            steps[f"os_L{L}"] = _chain(functools.partial(
+                _convolve_overlap_save_xla, L=L, out_length=out_len))
+        steps["fft"] = _chain(functools.partial(
+            _convolve_fft_xla,
+            fft_length=fft_convolution_length(n, m),
+            out_length=out_len))
+
+        # correctness spot-check of the parameterized band
+        want = np.asarray(_convolve_fft_xla(
+            x, hh, fft_length=fft_convolution_length(n, m),
+            out_length=out_len))
+        got = np.asarray(band_F(x, hh, F=512))
+        scale = max(1.0, np.abs(want).max())
+        err = np.abs(got - want).max() / scale
+
+        iters = 96 if n >= (1 << 20) else 256
+        sts = chain_stats(steps, x, iters, reps=3, on_floor="nan",
+                          null_carry=x[..., :8], attempts=2,
+                          attempt_gap_s=2.0)
+        ms = B * n / 1e6
+        print(f"B={B} n={n} m={m}  (band_F512 vs fft relerr {err:.1e})",
+              flush=True)
+        for name, st in sorted(sts.items()):
+            sec, raw = st.get("sec"), st.get("raw_sec")
+            msps = ms / sec if sec and np.isfinite(sec) else float("nan")
+            rmsps = (ms / raw if raw and np.isfinite(raw)
+                     else float("nan"))
+            e = f"  ERR {st['error'][:60]}" if st.get("error") else ""
+            print(f"  {name:12s} corrected {msps:7.0f}  raw {rmsps:7.0f}"
+                  f" MS/s{e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,9 +75,10 @@ ALGORITHMS = ("direct", "fft", "overlap_save")
 # matmul on the MXU (_convolve_direct_mxu_xla) — it beats the batched
 # block FFT up to h ~ 4-8k and the old VPU shift-add everywhere past
 # h ~ 15, at constant compile time; (b) its frames matrix costs
-# ~(h/128)x the signal in HBM, so the auto-selector hands h > 1024 to
-# overlap-save (within 2x of mxu there, O(n) memory) and only explicit
-# algorithm="direct" requests ride the band past that, capped at
+# ~(1 + (h-1)/F)x the signal in HBM at frame width F (_mxu_frame_for
+# widens F with h, r5), so the auto-selector hands h > _DIRECT_MAX_H to
+# overlap-save (O(n) memory) and only explicit algorithm="direct"
+# requests ride the band past that, capped at
 # _DIRECT_MXU_MAX_H; (c) per-tap unrolling makes the VPU shift-add's
 # compile time linear in h — it remains the scan-friendly primitive
 # (causal_fir) and the impl="shift" measurement leg; (d) the batched
@@ -85,8 +86,19 @@ ALGORITHMS = ("direct", "fft", "overlap_save")
 # batch; (e) block/frame extraction must be reshape/concat, never
 # gather — TPU gathers serialize (measured 9x on overlap-save blocks,
 # 80x on the banded tap matrix).
+#   r5 stripe retune (tools/tune_os_stripe.py; corrected/raw, the
+#   n=65536 single-signal rows floored at 256 chain iters and were
+#   discarded — only n=1M and the (64, 16384) batch rows differentiate):
+#   m=2047: band(F=256) 6262/784 @1M, 4648/1576 batched  vs  os(best L)
+#           5404/759 @1M, 3058/1340 batched  vs  fft 1021/476
+#   m=8191: os(L=32768) 3055/695 @1M  vs  band(F=512) 2381/651,
+#           fft 1004/474 — overlap-save keeps the h > 2048 range
+#   (os_block_length's max(8192, 4*next_pow2(h)) already lands on the
+#   measured L winner: 32768 at m=8191, and the h <= 2048 stripe now
+#   belongs to the band, so the r3-tuned floor stands.)
 _OS_MIN_X = 16384       # >= 2 blocks of the 8192 floor: overlap-save wins
-_DIRECT_MAX_H = 1024    # mxu-band beats the block FFT below this
+_DIRECT_MAX_H = 2048    # mxu-band beats the block FFT/os below this (r5:
+#                         F=256 band > os at m=2047 on every reliable row)
 _DIRECT_MXU_MAX_H = 8192     # explicit-direct band cap (frames memory)
 _DIRECT_UNROLL_MAX_H = 512   # shift-add unroll ceiling (compile time)
 # auto-selector HBM bound for the band's frames matrix: the frames
@@ -104,8 +116,9 @@ _PALLAS_CONV_MAX_X = 2048    # hand-kernel gate: measured waiver in
 
 
 def _mxu_frames_elems(x_length: int, h_length: int) -> int:
-    """f32 elements the band path's frames matrix materializes."""
-    F = _MXU_FRAME
+    """f32 elements the band path's frames matrix materializes (at the
+    frame width the kernel length selects, _mxu_frame_for)."""
+    F = _mxu_frame_for(h_length)
     nblk = -(-(x_length + h_length - 1) // F)
     return nblk * (F + h_length - 1)
 
@@ -204,9 +217,22 @@ def _convolve_direct_xla(x, h, reverse=False):
 
 #: banded-matmul frame width: 128 = one MXU tile of output columns per
 #: frame row. Measured fastest at m=127/x=65536 (F=128 raw 21.6 GS/s vs
-#: F=256 13.3 at HIGHEST); relative band overhead (F+m-1)/m shrinks as m
-#: grows, so one constant serves the whole direct range.
+#: F=256 13.3 at HIGHEST) — but only for SMALL kernels: the frames
+#: matrix expands HBM by K/F = (F+m-1)/F, so at m >= ~1k a wider frame
+#: trades a little MXU overhead for a many-fold HBM cut. r5 stripe
+#: sweep (tools/tune_os_stripe.py, corrected/raw MS/s): at m=2047 the
+#: F=256 band measured 6,262/784 (n=1M) and 4,648/1,576 (64x16384) vs
+#: F=128's 6,135/778 and 2,170/1,135; at m=8191 (n=1M) F=512 measured
+#: 2,381/651 vs F=128's 1,046/484. _mxu_frame_for scales F with m.
 _MXU_FRAME = 128
+
+
+def _mxu_frame_for(h_length: int) -> int:
+    """Frame width policy: r4's 128 where it was tuned (m <= 512), one
+    step wider per ~4x kernel growth beyond (r5 measured table above)."""
+    if h_length <= 512:
+        return _MXU_FRAME
+    return 256 if h_length <= 4096 else 512
 
 
 @functools.partial(jax.jit, static_argnames=("reverse",))
@@ -243,7 +269,7 @@ def _convolve_direct_mxu_xla(x, h, reverse=False):
     if not reverse:
         h = h[::-1]  # correlation orientation: out[t] = sum_j h[j] xp[t+j]
     n, m = x.shape[-1], h.shape[-1]
-    F = _MXU_FRAME
+    F = _mxu_frame_for(m)  # widens with m: K/F HBM expansion control
     K = F + m - 1
     out_len = n + m - 1
     nblk = -(-out_len // F)
@@ -478,7 +504,21 @@ def convolve_initialize(x_length: int, h_length: int,
                             x_length, h_length, None, reverse=reverse,
                             impl=impl, batch=rb)
                     return fb_cache[rb](x, h)
-                return _convolve_direct_xla(x, h, reverse=reverse)
+                if h_length <= _DIRECT_UNROLL_MAX_H:
+                    return _convolve_direct_xla(x, h, reverse=reverse)
+                # explicit-direct, mid/large kernel, oversized batch:
+                # slice the batch through the band in bound-sized row
+                # groups — the degenerate-conv fallback compiles
+                # superlinearly (53 s at x=4096, <1 MS/s) and would
+                # regress shapes the unclamped band used to run
+                x = jnp.asarray(x)
+                rows_per = max(1, _DIRECT_MXU_MAX_ELEMS
+                               // _mxu_frames_elems(x_length, h_length))
+                lead, xf = x.shape[:-1], x.reshape(-1, x.shape[-1])
+                outs = [_band(xf[i:i + rows_per], h)
+                        for i in range(0, xf.shape[0], rows_per)]
+                out = jnp.concatenate(outs, axis=0)
+                return out.reshape(lead + out.shape[-1:])
         else:
             # oversized explicit-direct: the band's frames matrix would
             # cost ~(h/128)x the signal in HBM; _convolve_direct_xla is

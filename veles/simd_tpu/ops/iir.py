@@ -48,6 +48,20 @@ Stability note: the scan materializes products of M along the tree
 overflow float32 — the same divergence a sequential implementation
 hits, reached faster. Design filters with the usual stability margins
 (butter_sos etc.).
+
+Short-signal ceiling (measured waiver, r5 — tools/tune_iir_short.py):
+the sub-block flat path is at its additive floor. At (256, 4096)
+butter-6 the raw per-step decomposition is transpose+u-build base
+(~208 us) + three dependent per-section trees (~105 us each) + the
+inter-section rebuilds (~75 us) = the measured whole; the only
+removable fat was the section-axis ``lax.scan`` carry boundary, now
+unrolled above ``_IIR_UNROLL_ELEMS`` (1.10-1.19x measured). Rejected
+with numbers: a joint 6-dim state-space single tree (827 vs 2,256 MS/s
+corrected — 36 plane-FMAs per combine), the r4 software-pipelined
+all-sections layout (132 MS/s), and the einsum companion form (r2,
+~28x slower). A cascade of S sections fundamentally runs S dependent
+trees; nothing on this hardware merges them cheaper than the unrolled
+flat planes.
 """
 
 from __future__ import annotations
@@ -241,17 +255,18 @@ def _sosfilt_xla(x, sos, s0, n_sections, chunk=0):
                 jnp.stack(finals, axis=-2).reshape(
                     lead + (n_sections, 2)))
 
-    if use_chunked or n > 32768:
-        # UNROLLED cascade for long signals: wrapping the section math
-        # in a section-axis lax.scan makes the scans nest three deep
-        # once a caller's scan (or a bench chain) encloses the op, and
-        # the XLA:TPU compile falls off a cliff — a 16-step chain of
-        # (16, 262144) sosfilt never finished compiling in 10 minutes,
-        # for BOTH the blocked form (chain/cascade/block scans) and the
-        # flat form (chain/cascade/262k-level associative scan), while
-        # the unrolled equivalents compile in seconds and measured
-        # 358 / 134 MS/s on-chip. Long signals are the rare case — six
-        # inlined section copies is fine.
+    if use_chunked or n > 32768 or batch * n >= _IIR_UNROLL_ELEMS:
+        # UNROLLED cascade for long signals AND large flat workloads:
+        # wrapping the section math in a section-axis lax.scan makes the
+        # scans nest three deep once a caller's scan (or a bench chain)
+        # encloses the op, and the XLA:TPU compile falls off a cliff —
+        # a 16-step chain of (16, 262144) sosfilt never finished
+        # compiling in 10 minutes, for BOTH the blocked form
+        # (chain/cascade/block scans) and the flat form (chain/cascade/
+        # 262k-level associative scan), while the unrolled equivalents
+        # compile in seconds and measured 358 / 134 MS/s on-chip. At
+        # batch*n >= _IIR_UNROLL_ELEMS the scan's carry boundary also
+        # costs measurable runtime (r5: 1.10-1.19x, policy block below).
         finals = []
         yT = xT
         for k in range(n_sections):
@@ -299,6 +314,23 @@ def _check_sos(sos):
 # 4096 / 2048 / 8192 / 16384 vs 146 flat — 4096 stays the winner.
 # Override per call for tuning.
 _IIR_CHUNK = 4096
+
+# Short-signal flat-tree policy (VERDICT r4 item 3, measured r5 on-chip
+# by tools/tune_iir_short.py, butter-6): wrapping the section cascade in
+# a lax.scan costs a real carry boundary per section at bench scale —
+# unrolling the Python loop measured 2,686 vs 2,256 MS/s corrected at
+# (256, 4096), 3,512 vs 3,151 at (256, 2048), 1,913 vs 1,738 at
+# (64, 4096). Above this many elements the flat path unrolls; below,
+# the scan form keeps compile time flat for the small-shape test sweeps
+# (an unrolled 6-section flat tree measured ~15 s of XLA:CPU compile in
+# r3). Ceiling evidence, (256, 4096) raw per step: transpose+u-build
+# base 208 us + 3 x 105 us per-section tree + ~75 us inter-section
+# rebuilds = 597 us measured — the formulation sits at its additive
+# floor; the remaining candidates measured WORSE: a joint 6-dim
+# state-space single tree 827 MS/s corrected (3.3x slower — 36
+# plane-FMAs per combine defeat the 2-plane sections), and the r4
+# software-pipelined all-sections layout 132 MS/s. Don't retry either.
+_IIR_UNROLL_ELEMS = 1 << 18
 
 
 def _chunk_policy(n, chunk):
