@@ -102,3 +102,60 @@ class TestZoomFft:
                                           height=0.3 * float(z.max()),
                                           distance=20)
         assert int(count) == 2
+
+
+class TestDirectMatmulPolicy:
+    """r5: small-m transforms ride the dense chirp matmul
+    (_czt_direct_*_xla); Bluestein keeps large n*m. Both paths must
+    agree to f32 tolerance on either side of the policy boundary."""
+
+    def test_direct_and_bluestein_agree(self, rng, monkeypatch):
+        import importlib
+
+        Z = importlib.import_module("veles.simd_tpu.ops.czt")
+
+        x = rng.normal(size=(3, 700)).astype(np.float32)
+        w = np.exp(-2j * np.pi / 160)
+        a = np.exp(2j * np.pi * 0.03)
+        direct = np.asarray(ops.czt(x, 160, w, a))  # under the bound
+        monkeypatch.setattr(Z, "_CZT_DIRECT_MAX_NM", 0)  # force Bluestein
+        blue = np.asarray(ops.czt(x, 160, w, a))
+        scale = np.abs(blue).max()
+        np.testing.assert_allclose(direct / scale, blue / scale,
+                                   atol=5e-6)
+
+    def test_complex_input_direct(self, rng):
+        from scipy.signal import czt as sczt
+
+        x = (rng.normal(size=300) + 1j * rng.normal(size=300)).astype(
+            np.complex64)
+        got = np.asarray(ops.czt(x, 64))
+        want = sczt(np.asarray(x, np.complex128), m=64)
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=5e-6)
+
+    def test_off_circle_direct_exponent_gate(self, rng, monkeypatch):
+        # a spiral fine for Bluestein's kmax^2/2 exponent but past the
+        # direct form's larger n*m exponent (needs n*m > kmax^2/2, i.e.
+        # min >= max/2) must silently skip the matmul panes and take
+        # Bluestein
+        import importlib
+
+        Z = importlib.import_module("veles.simd_tpu.ops.czt")
+        n, m = 512, 400
+        logw = 5e-4  # kmax^2/2 * logw = 65.5 <= 80 < n*m * logw = 102
+        w = complex(np.exp(logw - 2j * np.pi / m))
+        x = rng.normal(size=n).astype(np.float32)
+        called = {"n": 0}
+        real = Z._chirp_matrix_panes
+
+        def spy(*args):
+            called["n"] += 1
+            return real(*args)
+
+        monkeypatch.setattr(Z, "_chirp_matrix_panes", spy)
+        out = np.asarray(ops.czt(x, m, w))
+        assert called["n"] == 0  # gate tripped: Bluestein served it
+        # (no finiteness claim: an e^65 magnitude span is inside the
+        # documented gradual-degradation band of the f32 contract)
+        assert out.shape == (m,)

@@ -96,14 +96,16 @@ def main():
     stream_band = jax.jit(lambda c: stream_leg(c, True))
     xs2 = (fir0.tail, swt0.tail, x0)
 
-    for label, carry, legs, samples in (
+    stream_null = (fir0.tail[:1, :4], swt0.tail[:1, :4], x0[:1, :8])
+    for label, carry, legs, samples, null in (
             ("flagship(128,4096)", x,
-             {"shift_add": flag_prod, "mxu_band": flag_band}, B * n),
+             {"shift_add": flag_prod, "mxu_band": flag_band}, B * n,
+             x[:1, :8]),
             ("stream(256,4096)", xs2,
              {"shift_add": stream_prod, "mxu_band": stream_band},
-             Bs * chunk)):
+             Bs * chunk, stream_null)):
         sts = chain_stats(legs, carry, 512, reps=3, on_floor="nan",
-                          null_carry=carry[:1, :8], attempts=2,
+                          null_carry=null, attempts=2,
                           attempt_gap_s=2.0)
         msg = label
         for name, st in sts.items():

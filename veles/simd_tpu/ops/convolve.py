@@ -102,11 +102,12 @@ _DIRECT_MAX_H = 2048    # mxu-band beats the block FFT/os below this (r5:
 _DIRECT_MXU_MAX_H = 8192     # explicit-direct band cap (frames memory)
 _DIRECT_UNROLL_MAX_H = 512   # shift-add unroll ceiling (compile time)
 # auto-selector HBM bound for the band's frames matrix: the frames
-# expansion is ~(1 + h/128)x the signal, so huge signals with wide
-# kernels must not auto-ride it (n=2^28 f32 at h=1024 would build ~9 GB
-# of frames on a 16 GB chip). 2^27 f32 elements = 512 MB per signal;
-# batch multiplies this — callers batching large convolutions should
-# pass algorithm="overlap_save" explicitly where memory is tight.
+# expansion is ~(1 + (h-1)/F)x the signal at the _mxu_frame_for frame
+# width (r5: ~5x at h=1024/F=256 — n=2^28 f32 there would still build
+# ~4.5 GB of frames on a 16 GB chip). 2^27 f32 elements = 512 MB per
+# signal; batch multiplies this — callers batching large convolutions
+# should pass algorithm="overlap_save" explicitly where memory is
+# tight.
 _DIRECT_MXU_MAX_ELEMS = 1 << 27
 _OS_BLOCK_MIN = 8192    # TPU-efficient FFT block floor (CPU policy was 4*h)
 _PALLAS_CONV_MAX_X = 2048    # hand-kernel gate: measured waiver in
@@ -235,8 +236,8 @@ def _mxu_frame_for(h_length: int) -> int:
     return 256 if h_length <= 4096 else 512
 
 
-@functools.partial(jax.jit, static_argnames=("reverse",))
-def _convolve_direct_mxu_xla(x, h, reverse=False):
+@functools.partial(jax.jit, static_argnames=("reverse", "F"))
+def _convolve_direct_mxu_xla(x, h, reverse=False, F=None):
     """Brute-force convolution as a banded-Toeplitz matmul on the MXU.
 
     The r1-r3 production direct path ran the m taps as shifted
@@ -269,7 +270,10 @@ def _convolve_direct_mxu_xla(x, h, reverse=False):
     if not reverse:
         h = h[::-1]  # correlation orientation: out[t] = sum_j h[j] xp[t+j]
     n, m = x.shape[-1], h.shape[-1]
-    F = _mxu_frame_for(m)  # widens with m: K/F HBM expansion control
+    if F is None:
+        # widens with m: K/F HBM expansion control. Explicit F exists
+        # so tools/tune_os_stripe.py sweeps THIS kernel, not a copy.
+        F = _mxu_frame_for(m)
     K = F + m - 1
     out_len = n + m - 1
     nblk = -(-out_len // F)
@@ -328,6 +332,15 @@ def causal_fir(x, h):
     TPU (see _convolve_direct_xla; an N=C=1 conv_general_dilated lowering
     is pathological, and batched convs still lose to the fused VPU pass
     for small m).
+
+    MXU-band candidacy: measured NO in context (r5,
+    tools/tune_causal_fir.py, VERDICT r4 item 7). Substituting the
+    banded-Toeplitz matmul at the m=31 FIR stage measured 26,572 vs the
+    shift-add's 27,505 MS/s corrected inside the flagship pipeline
+    (raw 2,337 vs 2,347 — a tie inside one fused composition, where the
+    band's frames materialization breaks XLA's normalize->FIR->SWT
+    fusion), and a raw tie (5,026 vs 5,043) in the latency-bound
+    (256, 4096) streaming step. The shift-add stays.
     """
     return _causal_fir_xla(x, h)
 
@@ -521,8 +534,9 @@ def convolve_initialize(x_length: int, h_length: int,
                 return out.reshape(lead + out.shape[-1:])
         else:
             # oversized explicit-direct: the band's frames matrix would
-            # cost ~(h/128)x the signal in HBM; _convolve_direct_xla is
-            # O(n) memory (shift-add to h=512, degenerate conv beyond)
+            # cost ~(1 + (h-1)/F)x the signal in HBM even at the widest
+            # frame; _convolve_direct_xla is O(n) memory (shift-add to
+            # h=512, degenerate conv beyond)
             fn = functools.partial(_convolve_direct_xla, reverse=reverse)
     elif algorithm == "fft":
         fft_length = fft_convolution_length(x_length, h_length)

@@ -19,6 +19,17 @@ yields every scale's 'same'-mode output at a common alignment — the
 scale axis rides the batch dimensions of XLA's FFT, and the wavelet
 bank FFT is precomputed host-side in float64 (and cached per
 (wavelet, scales, n)).
+
+MXU-DFT candidacy: measured NO (r5, tools/tune_dft_small.py, VERDICT
+r4 item 4). Replacing the rfft/irfft pair with cos/sin DFT matmuls —
+the trick that won 3.5x on Welch at nfft <= 2048 and 3x+ on czt at
+small m — measured 1,512 vs the FFT path's 3,822 MS/s corrected at
+(16, 1024) x 32 scales (L=2048, relerr 3e-7) and 1,378 vs 3,058 at
+L=4096. The difference from Welch/czt: the cwt's inverse transform
+runs at FULL length L for every scale (S*B rows of L^2 DFT work vs the
+FFT's L log L), so the matmul's FLOP disadvantage scales with L and
+the MXU rate advantage cannot close it even at the smallest production
+L. The FFT bank stays; don't retry.
 """
 
 from __future__ import annotations

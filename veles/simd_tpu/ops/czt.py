@@ -39,6 +39,65 @@ import numpy as np
 from veles.simd_tpu.config import resolve_impl
 
 
+# r5 MXU-DFT policy (measured by tools/tune_dft_small.py on-chip,
+# VERDICT r4 item 4): at small m the dense chirp matmul beats
+# Bluestein's fft/ifft pair outright — corrected/raw MS/s:
+#   (B=64,  n=16384, m=512): direct 8,780/1,944  vs  bluestein 2,614/1,294
+#   (B=64,  n=4096,  m=512): direct 7,996/593    vs  2,657/511
+#   (B=256, n=4096,  m=256): direct 31,555/2,360 vs  2,853/1,347
+#   (B=16,  n=32768, m=512): direct 2,224/803    vs  2,105/788  (parity)
+# The win collapses as the (n, m) panes reach ~16M f32, and the axon
+# tunnel rejects constant uploads past ~100 MB (HTTP 413 at a 256 MB
+# pane), so the direct path takes n*m <= 2^23 (32 MB per cos/sin pane)
+# and Bluestein keeps the rest. The same trick measured NO for cwt
+# (see ops/cwt.py policy note).
+_CZT_DIRECT_MAX_NM = 1 << 23
+
+
+@functools.lru_cache(maxsize=16)
+def _chirp_matrix_panes(n, m, w, a):
+    """Host-side f64 dense chirp matrix Z[j, k] = a^-j w^(jk) with
+    mod-2pi phase reduction, shipped as two read-only f32 (n, m) panes
+    (the complex64-upload and large-angle rules of _chirp_constants
+    apply here too). maxsize sized for per-frame zoom loops cycling
+    many bands (the _chirp_constants use case) while bounding worst-
+    case host RAM at 16 x 2 x 32 MB = 1 GB of largest-allowed panes;
+    loops over more than 16 distinct (n, m, w, a) bands re-pay the
+    O(n*m) host build per call."""
+    j = np.arange(n, dtype=np.float64)[:, None]
+    k = np.arange(m, dtype=np.float64)[None, :]
+    argw, arga = np.angle(w), np.angle(a)
+    logw, loga = np.log(np.abs(w)), np.log(np.abs(a))
+    phase = np.mod(j * k * argw - j * arga, 2 * np.pi)
+    mag = np.exp(j * k * logw - j * loga)
+    Z = mag * np.exp(1j * phase)
+    re = np.ascontiguousarray(Z.real, np.float32)
+    im = np.ascontiguousarray(Z.imag, np.float32)
+    re.setflags(write=False)
+    im.setflags(write=False)
+    return re, im
+
+
+@jax.jit
+def _czt_direct_real_xla(x, z_re, z_im):
+    P = jax.lax.Precision.HIGHEST
+    x = jnp.asarray(x, jnp.float32)
+    return jax.lax.complex(jnp.matmul(x, z_re, precision=P),
+                           jnp.matmul(x, z_im, precision=P))
+
+
+@jax.jit
+def _czt_direct_complex_xla(x, z_re, z_im):
+    P = jax.lax.Precision.HIGHEST
+    xr = jnp.real(x).astype(jnp.float32)
+    xi = jnp.imag(x).astype(jnp.float32)
+    return jax.lax.complex(
+        jnp.matmul(xr, z_re, precision=P)
+        - jnp.matmul(xi, z_im, precision=P),
+        jnp.matmul(xr, z_im, precision=P)
+        + jnp.matmul(xi, z_re, precision=P))
+
+
 @functools.lru_cache(maxsize=64)
 def _chirp_constants(n, m, w, a):
     """Host-side float64 chirp vectors with mod-2pi phase reduction ->
@@ -131,6 +190,17 @@ def _czt_impl(x, m, w, a, impl):
     if resolve_impl(impl) == "reference":
         from scipy.signal import czt as _czt
         return _czt(np.asarray(x), m=m, w=w, a=a, axis=-1)
+    # r5: dense chirp matmul at small m (policy block above). The
+    # direct exponent j*k*log|w| can exceed Bluestein's k^2/2 bound, so
+    # off-circle spirals re-check the float32 magnitude span.
+    if n * m <= _CZT_DIRECT_MAX_NM:
+        emax_d = n * m * abs(np.log(abs(w))) + n * abs(np.log(abs(a)))
+        if emax_d <= 80.0:
+            z_re, z_im = _chirp_matrix_panes(n, m, w, a)
+            xj = jnp.asarray(x)
+            fn = (_czt_direct_complex_xla
+                  if jnp.iscomplexobj(xj) else _czt_direct_real_xla)
+            return fn(xj, z_re, z_im)
     (an_re, an_im), (kern_re, kern_im), (post_re, post_im), L = \
         _chirp_constants(n, m, w, a)
     return _czt_xla(jnp.asarray(x), an_re, an_im, kern_re, kern_im,
