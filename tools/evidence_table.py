@@ -171,12 +171,13 @@ def render_summary(src, rec, ev):
                if rec.get("recorded_unix") else "") + ")"
             if rec.get("value") is not None else
             f"bench record `{os.path.basename(src)}`")
+    asof = f"; {tpu['asof']}" if tpu.get("asof") else ""
     body = (f"Round-{ev['round']} measured state ({ev['recorded']}): "
             f"CPU suite **{cpu['passed']} passed / {cpu['failed']} failed**"
             f" (monolithic, {cpu['wall']}) and {pf['passed']}/{pf['total']}"
             f" per-file suites; TPU suite (`VELES_TEST_TPU=1`) "
             f"**{tpu['passed']} passed / {tpu['failed']} failed / "
-            f"{tpu['skipped']} skipped** ({tpu['wall']}; skips = "
+            f"{tpu['skipped']} skipped** ({tpu['wall']}{asof}; skips = "
             f"{ev['skip_reason']}); `tools/tpu_smoke.py` "
             f"{smoke['ok']}/{smoke['total']} Mosaic-validated; "
             f"`dryrun_multichip` green at {dry} virtual devices; {head}.")

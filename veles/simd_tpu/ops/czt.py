@@ -24,6 +24,13 @@ gradually beyond, and the op rejects spirals past e^80 (where the
 constants overflow outright). Unit-circle transforms — the DFT/zoom
 cases — are unaffected at any size.
 
+r5 MXU policy: at small output counts the transform skips Bluestein
+entirely — X = x @ Z with the dense (n, m) chirp matrix Z[j, k] =
+a^-j w^(jk) host-built in f64 and contracted on the MXU, measured
+3-13x the FFT pair up to n*m = 2^23 pane elements (policy block and
+numbers at ``_CZT_DIRECT_MAX_NM``; parity by 16M, and the axon tunnel
+rejects larger constant uploads anyway).
+
 Oracle: scipy.signal.czt / zoom_fft via ``impl="reference"``
 (tests/test_czt.py differentials).
 """
