@@ -159,3 +159,30 @@ class TestDirectMatmulPolicy:
         # (no finiteness claim: an e^65 magnitude span is inside the
         # documented gradual-degradation band of the f32 contract)
         assert out.shape == (m,)
+
+
+def test_blocked_direct_matches_scipy(rng):
+    """The blocked chirp-matmul building blocks (one shared base pane +
+    per-chunk twiddles) reproduce scipy czt past the single-pane bound.
+    Not yet wired into dispatch — the policy needs its on-chip
+    measurement (tools/tune_dft_small.py czt-blocked legs) — but the
+    algebra Z[c*nc+i, k] = t_c[k] * Z0[i, k] is environment-independent
+    and pinned here."""
+    import importlib
+
+    import jax.numpy as jnp
+    from scipy.signal import czt as sczt
+
+    Z = importlib.import_module("veles.simd_tpu.ops.czt")
+    n, m, nc = 5000, 160, 1024
+    w = complex(np.exp(-2j * np.pi * 0.11 / m))
+    a = complex(np.exp(2j * np.pi * 0.02))
+    x = rng.normal(size=(3, n)).astype(np.float32)
+    (b_re, b_im), (t_re, t_im), C = Z._chirp_blocked_constants(
+        n, m, w, a, nc)
+    assert C == -(-n // nc)
+    g = Z._czt_direct_blocked_xla(x, b_re, b_im, t_re, t_im, nc)
+    got = np.asarray(jnp.real(g)) + 1j * np.asarray(jnp.imag(g))
+    want = sczt(np.asarray(x, np.float64), m=m, w=w, a=a)
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(got / scale, want / scale, atol=5e-6)

@@ -34,6 +34,18 @@ def chirp_matrix(n, m, w, a):
     return mag * np.exp(1j * phase)
 
 
+
+def _report(label, sts, ms):
+    line = label
+    for name, st in sts.items():
+        sec = st.get("sec")
+        msps = ms / sec if sec and np.isfinite(sec) else float("nan")
+        raw = st.get("raw_sec")
+        rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
+        e = f" ERR:{st['error'][:60]}" if st.get("error") else ""
+        line += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
+    print(line, flush=True)
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -85,15 +97,37 @@ def main():
                           null_carry=x[:1, :8], attempts=2,
                           attempt_gap_s=2.0)
         ms = B * n / 1e6
-        line = f"czt B={B} n={n} m={m} relerr={err:.1e}"
-        for name, st in sts.items():
-            sec = st.get("sec")
-            msps = ms / sec if sec and np.isfinite(sec) else float("nan")
-            raw = st.get("raw_sec")
-            rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
-            e = f" ERR:{st['error'][:60]}" if st.get("error") else ""
-            line += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
-        print(line, flush=True)
+        _report(f"czt B={B} n={n} m={m} relerr={err:.1e}", sts, ms)
+
+    # ---------------- czt blocked (past the single-pane bound) -------
+    import importlib
+
+    Z = importlib.import_module("veles.simd_tpu.ops.czt")
+    for (B, n, m, nc) in [(64, 65536, 512, 8192),
+                          (16, 131072, 256, 16384),
+                          (256, 65536, 160, 16384)]:
+        x = jnp.asarray(rng.normal(size=(B, n)).astype(np.float32))
+        w = complex(np.exp(-2j * np.pi * 0.1 / m))
+        a = complex(np.exp(2j * np.pi * 0.05))
+        (b_re, b_im), (t_re, t_im), C = Z._chirp_blocked_constants(
+            n, m, w, a, nc)
+
+        def bstep(c, b_re=b_re, b_im=b_im, t_re=t_re, t_im=t_im, nc=nc):
+            y = Z._czt_direct_blocked_xla(c, b_re, b_im, t_re, t_im, nc)
+            return c * decay + jnp.float32(1e-6) * (jnp.real(y).sum()
+                                                    + jnp.imag(y).sum())
+
+        def fstep(c, w=w, a=a, m=m):
+            y = ops.czt(c, m, w, a)
+            return c * decay + jnp.float32(1e-6) * (jnp.real(y).sum()
+                                                    + jnp.imag(y).sum())
+
+        sts = chain_stats({"blocked_mm": bstep, "bluestein": fstep},
+                          x, 192, reps=3, on_floor="nan",
+                          null_carry=x[:1, :8], attempts=2,
+                          attempt_gap_s=2.0)
+        ms = B * n / 1e6
+        _report(f"czt-blocked B={B} n={n} m={m} nc={nc}", sts, ms)
 
     # ---------------- cwt ----------------
     for (B, n, S) in [(16, 1024, 32), (16, 2048, 32), (4, 8192, 32),
@@ -153,15 +187,7 @@ def main():
                           null_carry=x[:1, :8], attempts=2,
                           attempt_gap_s=2.0)
         ms = B * n * S / 1e6  # scale-bank output samples
-        line = f"cwt B={B} n={n} S={S} L={L} relerr={err:.1e}"
-        for name, st in sts.items():
-            sec = st.get("sec")
-            msps = ms / sec if sec and np.isfinite(sec) else float("nan")
-            raw = st.get("raw_sec")
-            rmsps = ms / raw if raw and np.isfinite(raw) else float("nan")
-            e = f" ERR:{st['error'][:60]}" if st.get("error") else ""
-            line += f"  {name} {msps:.0f}/{rmsps:.0f}{e}"
-        print(line, flush=True)
+        _report(f"cwt B={B} n={n} S={S} L={L} relerr={err:.1e}", sts, ms)
 
 
 if __name__ == "__main__":

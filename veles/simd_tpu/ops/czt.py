@@ -61,6 +61,17 @@ from veles.simd_tpu.config import resolve_impl
 _CZT_DIRECT_MAX_NM = 1 << 23
 
 
+def _pair(z):
+    """Complex -> two contiguous read-only f32 panes (the upload
+    contract: the axon tunnel cannot transfer complex64, and the jit
+    boundary wants hashable, immutable numpy constants)."""
+    re = np.ascontiguousarray(z.real, np.float32)
+    im = np.ascontiguousarray(z.imag, np.float32)
+    re.setflags(write=False)
+    im.setflags(write=False)
+    return re, im
+
+
 @functools.lru_cache(maxsize=16)
 def _chirp_matrix_panes(n, m, w, a):
     """Host-side f64 dense chirp matrix Z[j, k] = a^-j w^(jk) with
@@ -77,12 +88,44 @@ def _chirp_matrix_panes(n, m, w, a):
     logw, loga = np.log(np.abs(w)), np.log(np.abs(a))
     phase = np.mod(j * k * argw - j * arga, 2 * np.pi)
     mag = np.exp(j * k * logw - j * loga)
-    Z = mag * np.exp(1j * phase)
-    re = np.ascontiguousarray(Z.real, np.float32)
-    im = np.ascontiguousarray(Z.imag, np.float32)
-    re.setflags(write=False)
-    im.setflags(write=False)
-    return re, im
+    return _pair(mag * np.exp(1j * phase))
+
+
+@functools.lru_cache(maxsize=16)
+def _chirp_blocked_constants(n, m, w, a, nc):
+    """Blocked form of the dense chirp matmul: with j = c*nc + i,
+    Z[j, k] = a^-j w^(jk) = t_c[k] * Z0[i, k] * s_c — every n-chunk
+    contracts against the SAME (nc, m) base pane Z0[i, k] = a^-i w^(ik)
+    and applies a per-chunk (m,) twiddle t_c[k] = w^(c*nc*k) and scalar
+    s_c = a^-(c*nc) ... folded together here as one complex (C, m)
+    twiddle table. Extends the small-m MXU win past the single-pane
+    upload bound at O(pane + C*m) memory."""
+    C = -(-n // nc)
+    base = _chirp_matrix_panes(nc, m, w, a)
+    argw, arga = np.angle(w), np.angle(a)
+    logw, loga = np.log(np.abs(w)), np.log(np.abs(a))
+    c0 = (np.arange(C, dtype=np.float64) * nc)[:, None]
+    k = np.arange(m, dtype=np.float64)[None, :]
+    phase = np.mod(c0 * k * argw - c0 * arga, 2 * np.pi)
+    mag = np.exp(c0 * k * logw - c0 * loga)
+    return base, _pair(mag * np.exp(1j * phase)), C
+
+
+@functools.partial(jax.jit, static_argnames=("nc",))
+def _czt_direct_blocked_xla(x, z_re, z_im, t_re, t_im, nc):
+    """x real (..., n) against the shared base pane + chunk twiddles."""
+    P = jax.lax.Precision.HIGHEST
+    n = x.shape[-1]
+    C = t_re.shape[0]
+    lead = x.shape[:-1]
+    xp = jnp.pad(jnp.asarray(x, jnp.float32),
+                 [(0, 0)] * (x.ndim - 1) + [(0, C * nc - n)])
+    xb = xp.reshape(lead + (C, nc))
+    pre = jnp.matmul(xb, z_re, precision=P)     # (..., C, m)
+    pim = jnp.matmul(xb, z_im, precision=P)
+    re = jnp.sum(pre * t_re - pim * t_im, axis=-2)
+    im = jnp.sum(pre * t_im + pim * t_re, axis=-2)
+    return jax.lax.complex(re, im)
 
 
 @jax.jit
@@ -138,14 +181,7 @@ def _chirp_constants(n, m, w, a):
     # ship every complex constant as a real/imag float32 pair and
     # recombine on-device: the axon tunnel cannot transfer complex64
     # host->device, and one failed upload poisons the backend process
-    # (the r3 cwt-bank lesson; same contract here)
-    def _pair(z):
-        re = np.ascontiguousarray(z.real, np.float32)
-        im = np.ascontiguousarray(z.imag, np.float32)
-        re.setflags(write=False)
-        im.setflags(write=False)
-        return re, im
-
+    # (the r3 cwt-bank lesson; _pair is the one home of the contract)
     return (_pair(an), _pair(kern_fft), _pair(wk2[:m]), L)
 
 
