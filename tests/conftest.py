@@ -106,10 +106,12 @@ def pytest_sessionfinish(session, exitstatus):
     that property to the suite counts the evidence-summary blocks
     quote: a full ``pytest tests/`` run that ends green rewrites the
     matching EVIDENCE.json entry (CPU or TPU by VELES_TEST_TPU) and
-    re-splices the generated blocks, so the counts can never drift
-    from a run that actually happened. Partial or filtered runs, and
-    red runs, change nothing. Opt out: VELES_UPDATE_EVIDENCE=0."""
-    import json
+    re-splices the generated blocks via evidence_table.refresh_entry
+    (two-phase: counts file and blocks move together or not at all).
+    Partial, filtered (-k/-m/--lf/--deselect/--ignore), red, and
+    xdist-worker runs change nothing. Opt out: VELES_UPDATE_EVIDENCE=0.
+    """
+    import sys
     import time
 
     if os.environ.get("VELES_UPDATE_EVIDENCE") == "0" or exitstatus != 0:
@@ -125,7 +127,9 @@ def pytest_sessionfinish(session, exitstatus):
                 or getattr(opt, "markexpr", "")
                 or getattr(opt, "lf", False)
                 or getattr(opt, "last_failed", False)
-                or getattr(opt, "deselect", None))
+                or getattr(opt, "deselect", None)
+                or getattr(opt, "ignore", None)
+                or getattr(opt, "ignore_glob", None))
     if not full or filtered:
         return
     rep = session.config.pluginmanager.get_plugin("terminalreporter")
@@ -136,20 +140,16 @@ def pytest_sessionfinish(session, exitstatus):
     if counts["passed"] == 0:
         return
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(repo, "EVIDENCE.json")
-    before = None
-    try:
-        with open(path) as f:
-            before = f.read()
-        ev = json.loads(before)
-        key = "tpu_suite" if _ON_TPU else "cpu_suite"
+    key = "tpu_suite" if _ON_TPU else "cpu_suite"
+
+    def mutate(ev):
         entry = dict(ev.get(key, {}))
         same = (entry.get("passed") == counts["passed"]
                 and entry.get("failed") == counts["failed"]
                 and (not _ON_TPU
                      or entry.get("skipped") == counts["skipped"]))
         if same and not entry.get("asof"):
-            return  # identical counts: keep the recorded wall time
+            return False  # identical counts: keep the recorded wall
         wall = int(time.time() - rep._sessionstarttime)
         entry.update(passed=counts["passed"], failed=counts["failed"],
                      wall=f"{wall // 60}:{wall % 60:02d}")
@@ -158,23 +158,14 @@ def pytest_sessionfinish(session, exitstatus):
         entry.pop("asof", None)  # counts are now from a real run
         ev[key] = entry
         ev["recorded"] = time.strftime("%Y-%m-%d")
-        with open(path, "w") as f:
-            json.dump(ev, f, indent=2)
-            f.write("\n")
-        import sys as _sys
-        _sys.path.insert(0, os.path.join(repo, "tools"))
+
+    try:
+        sys.path.insert(0, os.path.join(repo, "tools"))
         import evidence_table
-        evidence_table.update(write=True)
-        print(f"\nEVIDENCE.json {key} refreshed: {counts}")
+        if evidence_table.refresh_entry(mutate):
+            print(f"\nEVIDENCE.json {key} refreshed: {counts}")
     except (Exception, SystemExit) as e:
-        # refresh must never fail the run (evidence_table raises
-        # SystemExit on missing records/markers); leave a CONSISTENT
-        # state behind — counts file and spliced blocks move together
-        # or not at all
-        if before is not None:
-            try:
-                with open(path, "w") as f:
-                    f.write(before)
-            except OSError:
-                pass
+        # must never fail the run (evidence_table raises SystemExit on
+        # missing records/markers; refresh_entry already left a
+        # consistent state behind)
         print(f"\nevidence refresh skipped: {e}")

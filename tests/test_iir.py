@@ -579,3 +579,21 @@ def test_zpk_pairing_bounds_intermediate_gain():
         # monotonically (max observed ratio ~1.0); 10x headroom keeps
         # the bound meaningful without pinning the exact pairing
         assert max(peaks) <= 10.0 * final, (peaks, final)
+
+
+def test_unroll_threshold_boundary_equivalence(rng):
+    """The r5 flat-path unroll policy (_IIR_UNROLL_ELEMS) must be
+    numerically invisible: shapes just below (scan cascade) and just
+    above (unrolled loop) the 2^18-element boundary both match the f64
+    oracle."""
+    from veles.simd_tpu.ops.iir import _IIR_UNROLL_ELEMS
+
+    sos = ops.butter_sos(6, 0.25)
+    n = 2048
+    b_under = _IIR_UNROLL_ELEMS // n - 1       # scan-cascade side (127)
+    b_over = -(-_IIR_UNROLL_ELEMS // n)        # unrolled side (128)
+    for b in (b_under, b_over):
+        x = rng.normal(size=(b, n)).astype(np.float32)
+        got = np.asarray(ops.sosfilt(x, sos))
+        want = np.asarray(ops.sosfilt(x, sos, impl="reference"))
+        assert np.abs(got - want).max() < 2e-4, b

@@ -223,19 +223,28 @@ def splice(path, blocks):
     return text
 
 
-def update(targets=None, bench=None, write=True):
-    """Regenerate every marker block. Returns the list of stale files
-    (files whose on-disk content differed from the regeneration)."""
+def _compute(targets=None, bench=None, evidence=None):
+    """Render every marker block and splice in memory — the PURE phase.
+    Raises (SystemExit on missing records/markers) before anything is
+    written, which is what makes update()/refresh_entry() atomic
+    against the realistic failure class."""
     src, rec = load_record(bench)
     if rec is None:
         raise SystemExit("no parseable bench record found")
+    ev = evidence if evidence is not None else load_evidence()
     blocks = [(BEGIN, END, render(src, rec)),
-              (SUM_BEGIN, SUM_END, render_summary(src, rec,
-                                                  load_evidence()))]
+              (SUM_BEGIN, SUM_END, render_summary(src, rec, ev))]
     targets = targets or [os.path.join(REPO, t) for t in DEFAULT_TARGETS]
+    return {path: splice(path, blocks) for path in targets}
+
+
+def update(targets=None, bench=None, write=True, evidence=None):
+    """Regenerate every marker block (two-phase: all splices computed
+    before any write). Returns the list of stale files (files whose
+    on-disk content differed from the regeneration)."""
+    new_texts = _compute(targets, bench, evidence)
     stale = []
-    for path in targets:
-        new_text = splice(path, blocks)
+    for path, new_text in new_texts.items():
         with open(path) as f:
             if f.read() != new_text:
                 stale.append(path)
@@ -243,6 +252,38 @@ def update(targets=None, bench=None, write=True):
                     with open(path, "w") as f2:
                         f2.write(new_text)
     return stale
+
+
+def refresh_entry(mutate):
+    """Shared EVIDENCE.json refresh for the full-suite hooks
+    (tests/conftest.py sessionfinish, tools/run_tests.py): ``mutate``
+    edits the loaded dict in place and returns False to skip. Every
+    generated block is computed BEFORE anything is written, so the
+    counts file and the spliced targets move together or not at all;
+    a mid-write OSError best-effort-restores EVIDENCE.json and
+    re-raises. Returns True when a refresh landed."""
+    path = os.path.join(REPO, "EVIDENCE.json")
+    with open(path) as f:
+        before = f.read()
+    ev = json.loads(before)
+    if mutate(ev) is False:
+        return False
+    new_texts = _compute(evidence=ev)
+    try:
+        with open(path, "w") as f:
+            json.dump(ev, f, indent=2)
+            f.write("\n")
+        for p, txt in new_texts.items():
+            with open(p, "w") as f:
+                f.write(txt)
+    except OSError:
+        try:
+            with open(path, "w") as f:
+                f.write(before)
+        except OSError:
+            pass
+        raise
+    return True
 
 
 def main():
