@@ -54,7 +54,10 @@ HEADLINE_METRIC = "matrix_multiply_f32_n4096"
 # fields until the line fits this budget (headroom under 2,000 for the
 # driver's own wrapping). tests/test_bench_line.py pins the contract:
 # the full record must json.loads from the line's last 2,000 bytes.
-LINE_BUDGET = 1780
+# r5: raised 1780 -> 1845 for the drift_anchor field (VERDICT r4 item
+# 2; the field serializes to 62 bytes at full precision), leaving 155 B
+# of wrapping margin against the driver's tail window.
+LINE_BUDGET = 1845
 _CFG_DEFAULT_UNIT = "MSamples/s"
 
 

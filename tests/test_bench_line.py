@@ -81,6 +81,8 @@ def maximal_record():
         "pallas_vs_xla": 1.08, "clamped_fields": ["pallas_gflops",
                                                   "attempts"],
         "backend": "tpu", "vs_ref_avx": 14409.6, "vs_ref_avx_raw": 13488.4,
+        "drift_anchor": {"n": 1024, "gflops": 167897,
+                         "raw_gflops": 133968},
         "leg_errors": {"pallas": "warm-up checksum non-finite"},
         "configs": configs,
     }
@@ -234,3 +236,27 @@ def test_supervisor_final_print_is_budgeted(capsys):
     rec = parse_driver_tail(out[0])
     assert rec["value"] == 159074.3
     assert len(rec["configs"]) == 12
+
+
+def test_drift_anchor_survives_budget_and_runs_on_cpu():
+    """The r5 chip-state anchor (VERDICT r4 item 2) must reach the
+    driver artifact: the maximal record carries it under budget, and
+    bench_drift_anchor itself runs at CPU smoke scale with finite,
+    physics-clamped output fields."""
+    line = bench.emit_record(maximal_record())
+    rec = parse_driver_tail(line)
+    assert rec["drift_anchor"]["gflops"] == 167897
+    assert rec["drift_anchor"]["raw_gflops"] == 133968
+
+    import os
+    if os.environ.get("VELES_TEST_TPU") == "1":
+        # on the chip the anchor runs its full 32k-iteration chain
+        # (~15 s of MXU plus tunnel compiles) and a hung tunnel blocks
+        # with no error — the live call is a CPU-smoke-scale check only
+        return
+    anchor = bench.bench_drift_anchor()
+    assert anchor.get("n") in (128, 1024)
+    g = anchor.get("gflops")
+    if g is not None:  # a floored CPU box may legitimately yield NaN->None
+        assert 0 < g <= bench.V5E_BF16_PEAK_GFLOPS
+    assert "error" not in anchor or isinstance(anchor["error"], str)

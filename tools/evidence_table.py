@@ -269,19 +269,31 @@ def refresh_entry(mutate):
     if mutate(ev) is False:
         return False
     new_texts = _compute(evidence=ev)
+    # snapshot every file the write phase touches, so ANY mid-write
+    # failure (ENOSPC, interrupt) restores the whole set — a partial
+    # write of the target list is exactly the counts-vs-prose drift
+    # this machinery exists to prevent
+    snapshots = {path: before}
+    for p in new_texts:
+        with open(p) as f:
+            snapshots[p] = f.read()
+    written = []
     try:
         with open(path, "w") as f:
+            written.append(path)
             json.dump(ev, f, indent=2)
             f.write("\n")
         for p, txt in new_texts.items():
             with open(p, "w") as f:
+                written.append(p)
                 f.write(txt)
-    except OSError:
-        try:
-            with open(path, "w") as f:
-                f.write(before)
-        except OSError:
-            pass
+    except BaseException:
+        for p in written:
+            try:
+                with open(p, "w") as f:
+                    f.write(snapshots[p])
+            except OSError:
+                pass
         raise
     return True
 
