@@ -106,7 +106,9 @@ def _prune_steps(rec: dict):
     driver's one-line view, never the evidence."""
     def all_recs():
         cfgs = rec.get("configs") or {}
-        return [rec] + [c for c in cfgs.values() if isinstance(c, dict)]
+        anchor = rec.get("drift_anchor")
+        return ([rec] + [c for c in cfgs.values() if isinstance(c, dict)]
+                + ([anchor] if isinstance(anchor, dict) else []))
 
     def trunc_errors(limit):
         for d in all_recs():
@@ -145,6 +147,9 @@ def _prune_steps(rec: dict):
             lambda: rec.pop("pallas_attempts", None),
             lambda: rec.pop("attempts", None),
             lambda: trunc_errors(80),
+            # a (possibly error-carrying) anchor yields before any
+            # measured config field does — the full record keeps it
+            lambda: rec.pop("drift_anchor", None),
             whitelist_cfgs,
             lambda: drop_cfg_keys(("raw_value",))]
 

@@ -98,6 +98,14 @@ def _bound_compile_cache():
         jax.clear_caches()
 
 
+_SESSION_T0 = [None]
+
+
+def pytest_sessionstart(session):
+    import time
+    _SESSION_T0[0] = time.time()
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Regenerate EVIDENCE.json's suite counts from FULL green runs.
 
@@ -150,7 +158,10 @@ def pytest_sessionfinish(session, exitstatus):
                      or entry.get("skipped") == counts["skipped"]))
         if same and not entry.get("asof"):
             return False  # identical counts: keep the recorded wall
-        wall = int(time.time() - rep._sessionstarttime)
+        # own start stamp: pytest renamed the reporter's private
+        # _sessionstarttime attr between versions (found live in r5 —
+        # the try-guard had been silently eating the refresh)
+        wall = int(time.time() - (_SESSION_T0[0] or time.time()))
         entry.update(passed=counts["passed"], failed=counts["failed"],
                      wall=f"{wall // 60}:{wall % 60:02d}")
         if _ON_TPU:

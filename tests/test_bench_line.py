@@ -260,3 +260,24 @@ def test_drift_anchor_survives_budget_and_runs_on_cpu():
     if g is not None:  # a floored CPU box may legitimately yield NaN->None
         assert 0 < g <= bench.V5E_BF16_PEAK_GFLOPS
     assert "error" not in anchor or isinstance(anchor["error"], str)
+
+
+def test_anchor_error_prunes_before_config_evidence():
+    """A failure-path anchor ({'error': <=120 chars}) must trim at the
+    error rungs and yield entirely before whole configs are shed — the
+    anchor is diagnostic; config fields are measurement evidence."""
+    rec = maximal_record()
+    rec["drift_anchor"] = {"n": 1024, "error": "E" * 120}
+    # bloat errors so the ladder must run deep
+    for cfg in rec["configs"].values():
+        cfg["error"] = "x" * 300
+    line = bench.emit_record(rec)
+    out = parse_driver_tail(line)
+    assert len(line.encode()) <= bench.LINE_BUDGET
+    # whichever depth the ladder reached: if the anchor survives its
+    # error is truncated; if configs were dropped the anchor is gone
+    anchor = out.get("drift_anchor")
+    if out.get("cfgs_dropped"):
+        assert anchor is None
+    if anchor is not None:
+        assert len(anchor.get("error", "")) <= 80

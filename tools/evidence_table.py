@@ -268,33 +268,28 @@ def refresh_entry(mutate):
     ev = json.loads(before)
     if mutate(ev) is False:
         return False
-    new_texts = _compute(evidence=ev)
-    # snapshot every file the write phase touches, so ANY mid-write
-    # failure (ENOSPC, interrupt) restores the whole set — a partial
-    # write of the target list is exactly the counts-vs-prose drift
-    # this machinery exists to prevent
-    snapshots = {path: before}
-    for p in new_texts:
-        with open(p) as f:
-            snapshots[p] = f.read()
-    written = []
+    new_texts = dict(_compute(evidence=ev))
+    new_texts[path] = json.dumps(ev, indent=2) + "\n"
+    # two-phase write via temp files + os.replace: every new text is
+    # fully ON DISK before any real file changes, so ENOSPC/interrupt
+    # during the write phase leaves the originals untouched (a
+    # rollback that rewrites originals in place would itself truncate
+    # on a full disk). os.replace is atomic per file.
+    temps = {}
     try:
-        with open(path, "w") as f:
-            written.append(path)
-            json.dump(ev, f, indent=2)
-            f.write("\n")
         for p, txt in new_texts.items():
-            with open(p, "w") as f:
-                written.append(p)
+            tmp = p + ".evtmp"
+            with open(tmp, "w") as f:
                 f.write(txt)
-    except BaseException:
-        for p in written:
+            temps[p] = tmp
+        for p in list(temps):
+            os.replace(temps.pop(p), p)
+    finally:
+        for tmp in temps.values():
             try:
-                with open(p, "w") as f:
-                    f.write(snapshots[p])
+                os.remove(tmp)
             except OSError:
                 pass
-        raise
     return True
 
 
