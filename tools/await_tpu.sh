@@ -8,14 +8,20 @@
 # The poll loop gives up after $VELES_WATCH_DEADLINE_S seconds (default
 # 90 min) and exits clean; the work phase itself is timeout-capped.
 #
-# Logs land under /tmp; the one repo-root artifact is TPU_EVIDENCE.md
-# (the harvest summary, written only after a successful recovery run so
-# the round records the evidence even if the operator is mid-task):
-#   /tmp/tpu_watch.log        - progress + summaries
-#   /tmp/tpu_smoke.log        - full Mosaic-validation output
-#   /tmp/tpu_suite.log        - full VELES_TEST_TPU pytest output
-#   /tmp/tune_matmul.log      - tile sweep table
-#   /tmp/bench_preview.json   - bench.py stdout (the driver-format line)
+# r5 sequence: smoke -> full bench -> VELES_TEST_TPU suite. The bench
+# itself re-splices the generated evidence blocks (bench.py auto-update)
+# and a full green TPU suite refreshes EVIDENCE.json's counts (conftest
+# sessionfinish hook) — so this script writes NO repo markdown itself.
+# (The pre-r5 version overwrote TPU_EVIDENCE.md with a raw harvest;
+# that file now carries generated marker blocks and must never be
+# clobbered — harvest goes to /tmp/tpu_harvest.md instead.)
+#
+# Logs land under /tmp:
+#   /tmp/tpu_watch.log   - progress + summaries (nohup redirect)
+#   /tmp/tpu_smoke.log   - full Mosaic-validation output
+#   /tmp/bench_full.out  - bench.py stdout (the driver-format line)
+#   /tmp/tpu_suite.log   - full VELES_TEST_TPU pytest output
+#   /tmp/tpu_harvest.md  - tails of everything, timestamped
 set -u
 cd /root/repo
 
@@ -27,34 +33,23 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
 
     echo "[watch] === tpu_smoke ==="
     timeout 1800 python tools/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1
-    tail -15 /tmp/tpu_smoke.log
+    tail -12 /tmp/tpu_smoke.log
 
-    echo "[watch] === tune_matmul sweep ==="
-    timeout 2400 python tools/tune_matmul.py > /tmp/tune_matmul.log 2>&1
-    tail -25 /tmp/tune_matmul.log
+    echo "[watch] === full bench (auto-splices evidence blocks) ==="
+    timeout 3600 python bench.py > /tmp/bench_full.out 2>/tmp/bench_full.err
+    echo "[watch] bench rc=$?"; tail -c 400 /tmp/bench_full.out; echo
 
-    echo "[watch] === bench.py ==="
-    timeout 2400 python bench.py > /tmp/bench_preview.json 2>/tmp/bench_err.log
-    cat /tmp/bench_preview.json
-
-    echo "[watch] === AVX-vs-TPU speedup table ==="
-    timeout 120 python tools/speedup_table.py \
-      --bench /tmp/bench_preview.json 2>&1 | tail -12
-
-    echo "[watch] === VELES_TEST_TPU suite ==="
-    timeout 3600 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
+    echo "[watch] === VELES_TEST_TPU suite (refreshes EVIDENCE.json) ==="
+    timeout 7200 env VELES_TEST_TPU=1 python -m pytest tests/ -q \
       > /tmp/tpu_suite.log 2>&1
-    tail -3 /tmp/tpu_suite.log
+    echo "[watch] suite rc=$?"; tail -3 /tmp/tpu_suite.log
 
-    # harvest the evidence into the repo so the round records it even
-    # if the operator is mid-task when recovery lands (committed later)
     {
-      echo "# TPU evidence harvest $(date -u +%Y-%m-%dT%H:%M:%SZ)"
-      echo; echo "## tpu_smoke tail"; tail -20 /tmp/tpu_smoke.log 2>/dev/null
-      echo; echo "## tune_matmul tail"; tail -25 /tmp/tune_matmul.log
-      echo; echo "## bench stdout"; cat /tmp/bench_preview.json
+      echo "# TPU harvest $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+      echo; echo "## tpu_smoke tail"; tail -15 /tmp/tpu_smoke.log
+      echo; echo "## bench stdout tail"; tail -c 2000 /tmp/bench_full.out
       echo; echo "## suite tail"; tail -5 /tmp/tpu_suite.log
-    } > TPU_EVIDENCE.md
+    } > /tmp/tpu_harvest.md
 
     echo "[watch] DONE $(date -u +%H:%M:%S)"
     exit 0
